@@ -1,0 +1,18 @@
+"""sml_tpu — a TPU-native scalable-ML framework.
+
+A from-scratch re-design of the capabilities exercised by the reference
+courseware (Databricks "Scalable Machine Learning with Apache Spark" 3.7.3):
+a partitioned DataFrame engine, Delta-lite versioned storage, an
+MLlib-compatible pipeline/estimator API whose distributed math runs as jitted
+XLA programs over a `jax.sharding.Mesh` with ICI collectives, tree/GBT
+histogram learners, tuning (grid CV + TPE), a pandas function API, and
+MLOps glue (tracking/registry/feature store/AutoML) — single-process Python
+driver, no JVM, native C++ for host-side hot ops.
+"""
+
+from .conf import GLOBAL_CONF
+from .frame import DataFrame, Row, TpuSession, functions, get_session
+from .version import __version__
+
+__all__ = ["TpuSession", "DataFrame", "Row", "functions", "get_session",
+           "GLOBAL_CONF", "__version__"]

@@ -1,0 +1,112 @@
+"""Typed layered configuration tree (the `spark.conf` equivalent).
+
+The reference uses Spark conf as an ad-hoc KV store: course keys
+(`SML/Includes/Classroom-Setup.py:2`), engine knobs such as
+`spark.sql.shuffle.partitions` (`Solutions/Labs/ML 00L`) and the Arrow batch
+size `spark.sql.execution.arrow.maxRecordsPerBatch`
+(`SML/ML 12 - Inference with Pandas UDFs.py:90,121`), plus Delta retention
+checks (`SML/ML 00c - Delta Review.py:235`).
+
+Here the same surface is one typed config tree: known keys carry a type and a
+default; unknown keys are allowed as free-form strings (the course stores its
+own `com.databricks.training.*` keys that way).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    default: Any
+    caster: Callable[[str], Any]
+    doc: str = ""
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
+_KNOWN: Dict[str, ConfEntry] = {}
+
+
+def _register(key: str, default: Any, caster: Callable[[str], Any], doc: str = "") -> None:
+    _KNOWN[key] = ConfEntry(key, default, caster, doc)
+
+
+# Engine knobs the courseware actually touches, plus our TPU-side knobs.
+_register("sml.shuffle.partitions", 8, int, "Partition count after shuffles (spark.sql.shuffle.partitions)")
+_register("spark.sql.shuffle.partitions", 8, int, "Alias kept for course compatibility")
+_register("sml.arrow.maxRecordsPerBatch", 10000, int, "Arrow record-batch size for pandas-fn fan-out")
+_register("spark.sql.execution.arrow.maxRecordsPerBatch", 10000, int, "Alias kept for course compatibility")
+_register("sml.delta.retentionDurationCheck.enabled", True, _to_bool, "Refuse vacuum(0) unless disabled")
+_register("spark.databricks.delta.retentionDurationCheck.enabled", True, _to_bool, "Alias for course compatibility")
+_register("sml.default.parallelism", 8, int, "Default partition count for new data sources")
+_register("sml.tpu.mesh.axis", "data", str, "Default 1-D mesh axis name")
+_register("sml.tpu.donate", True, _to_bool, "Donate input buffers on training steps")
+_register("sml.profiler.enabled", False, _to_bool, "Record op-level timings")
+
+
+class TpuConf:
+    """Thread-safe KV config with typed known keys and free-form extras."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._values: Dict[str, Any] = {}
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            ent = _KNOWN.get(key)
+            if ent is not None and not isinstance(value, type(ent.default)):
+                value = ent.caster(value)
+            self._values[key] = value
+            # Keep spark.* aliases and sml.* keys in sync both ways.
+            alias = _ALIASES.get(key)
+            if alias is not None:
+                self._values[alias] = value
+
+    def get(self, key: str, default: Optional[Any] = None) -> Any:
+        with self._lock:
+            if key in self._values:
+                return self._values[key]
+            ent = _KNOWN.get(key)
+            if ent is not None:
+                return ent.default
+            if default is not None:
+                return default
+            raise KeyError(f"No such config key: {key}")
+
+    def getInt(self, key: str) -> int:
+        return int(self.get(key))
+
+    def getBool(self, key: str) -> bool:
+        return _to_bool(self.get(key))
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            self._values.pop(key, None)
+
+    def asDict(self) -> Dict[str, Any]:
+        with self._lock:
+            d = {k: e.default for k, e in _KNOWN.items()}
+            d.update(self._values)
+            return d
+
+
+_ALIASES = {
+    "spark.sql.shuffle.partitions": "sml.shuffle.partitions",
+    "sml.shuffle.partitions": "spark.sql.shuffle.partitions",
+    "spark.sql.execution.arrow.maxRecordsPerBatch": "sml.arrow.maxRecordsPerBatch",
+    "sml.arrow.maxRecordsPerBatch": "spark.sql.execution.arrow.maxRecordsPerBatch",
+    "spark.databricks.delta.retentionDurationCheck.enabled": "sml.delta.retentionDurationCheck.enabled",
+    "sml.delta.retentionDurationCheck.enabled": "spark.databricks.delta.retentionDurationCheck.enabled",
+}
+
+# Process-wide conf (one driver process; no JVM — see SURVEY §2.3 Py4J row).
+GLOBAL_CONF = TpuConf()

@@ -1,0 +1,311 @@
+"""Column expression AST evaluated per-partition against pandas blocks.
+
+The subset of Spark's column algebra the courseware exercises (SURVEY §1 L1):
+arithmetic/comparison/boolean operators, cast, alias, isNull, when/otherwise,
+string ops (`translate`, `contains`), sort orders, and aggregate columns.
+Each Column carries an eval function ``(pdf, ctx) -> pd.Series`` so the whole
+expression tree runs vectorized on a partition block; partition-aware
+expressions (rand, monotonically_increasing_id) read the EvalContext.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from .types import DataType, parse_type
+
+
+@dataclass
+class EvalContext:
+    partition_index: int = 0
+    n_partitions: int = 1
+    row_offset: int = 0  # global row index of the partition's first row
+
+
+def _as_series(v, pdf: pd.DataFrame) -> pd.Series:
+    if isinstance(v, pd.Series):
+        return v
+    return pd.Series([v] * len(pdf), index=pdf.index)
+
+
+class Column:
+    def __init__(self, eval_fn: Callable[[pd.DataFrame, EvalContext], Any],
+                 name: str, *,
+                 agg: Optional[Callable[[pd.Series], Any]] = None,
+                 sort_desc: Optional[bool] = None,
+                 children: Optional[List["Column"]] = None):
+        self._eval_fn = eval_fn
+        self._name = name
+        self._agg = agg            # set ⇒ aggregate column (groupBy.agg / select-agg)
+        self._sort_desc = sort_desc
+        self._children = children or []
+
+    # -- evaluation --
+    def _eval(self, pdf: pd.DataFrame, ctx: Optional[EvalContext] = None) -> pd.Series:
+        ctx = ctx or EvalContext()
+        out = self._eval_fn(pdf, ctx)
+        return _as_series(out, pdf)
+
+    # -- naming --
+    def alias(self, name: str) -> "Column":
+        c = Column(self._eval_fn, name, agg=self._agg, sort_desc=self._sort_desc,
+                   children=self._children)
+        return c
+
+    name = alias
+
+    # -- operator helpers --
+    def _bin(self, other, fn, sym, reverse=False) -> "Column":
+        other_c = other if isinstance(other, Column) else LitColumn(other)
+
+        def ev(pdf, ctx):
+            a = self._eval(pdf, ctx)
+            b = other_c._eval(pdf, ctx)
+            return fn(b, a) if reverse else fn(a, b)
+
+        l, r = (other_c._name, self._name) if reverse else (self._name, other_c._name)
+        return Column(ev, f"({l} {sym} {r})")
+
+    def __add__(self, o):
+        return self._bin(o, lambda a, b: a + b, "+")
+
+    def __radd__(self, o):
+        return self._bin(o, lambda a, b: a + b, "+", reverse=True)
+
+    def __sub__(self, o):
+        return self._bin(o, lambda a, b: a - b, "-")
+
+    def __rsub__(self, o):
+        return self._bin(o, lambda a, b: a - b, "-", reverse=True)
+
+    def __mul__(self, o):
+        return self._bin(o, lambda a, b: a * b, "*")
+
+    def __rmul__(self, o):
+        return self._bin(o, lambda a, b: a * b, "*", reverse=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, lambda a, b: a / b, "/")
+
+    def __rtruediv__(self, o):
+        return self._bin(o, lambda a, b: a / b, "/", reverse=True)
+
+    def __neg__(self):
+        return Column(lambda pdf, ctx: -self._eval(pdf, ctx), f"(- {self._name})")
+
+    def __pow__(self, o):
+        return self._bin(o, lambda a, b: a ** b, "**")
+
+    def __mod__(self, o):
+        return self._bin(o, lambda a, b: a % b, "%")
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin(o, lambda a, b: a == b, "=")
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin(o, lambda a, b: a != b, "!=")
+
+    def __lt__(self, o):
+        return self._bin(o, lambda a, b: a < b, "<")
+
+    def __le__(self, o):
+        return self._bin(o, lambda a, b: a <= b, "<=")
+
+    def __gt__(self, o):
+        return self._bin(o, lambda a, b: a > b, ">")
+
+    def __ge__(self, o):
+        return self._bin(o, lambda a, b: a >= b, ">=")
+
+    def __and__(self, o):
+        return self._bin(o, lambda a, b: a.fillna(False).astype(bool) & b.fillna(False).astype(bool)
+                         if isinstance(a, pd.Series) and isinstance(b, pd.Series)
+                         else a & b, "AND")
+
+    def __or__(self, o):
+        return self._bin(o, lambda a, b: a.fillna(False).astype(bool) | b.fillna(False).astype(bool)
+                         if isinstance(a, pd.Series) and isinstance(b, pd.Series)
+                         else a | b, "OR")
+
+    def __invert__(self):
+        return Column(lambda pdf, ctx: ~self._eval(pdf, ctx).fillna(False).astype(bool),
+                      f"(NOT {self._name})")
+
+    def __hash__(self):
+        return id(self)
+
+    # -- null / membership --
+    def isNull(self) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).isna(),
+                      f"({self._name} IS NULL)")
+
+    def isNotNull(self) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).notna(),
+                      f"({self._name} IS NOT NULL)")
+
+    def isin(self, *values) -> "Column":
+        vals = list(values[0]) if len(values) == 1 and isinstance(values[0], (list, tuple, set)) else list(values)
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).isin(vals),
+                      f"({self._name} IN ...)")
+
+    def between(self, low, high) -> "Column":
+        return (self >= low) & (self <= high)
+
+    # -- strings --
+    def contains(self, sub: str) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).astype(str).str.contains(sub, regex=False),
+                      f"contains({self._name}, {sub})")
+
+    def startswith(self, p: str) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).astype(str).str.startswith(p),
+                      f"startswith({self._name}, {p})")
+
+    def endswith(self, p: str) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).astype(str).str.endswith(p),
+                      f"endswith({self._name}, {p})")
+
+    def like(self, pattern: str) -> "Column":
+        regex = "^" + pattern.replace("%", ".*").replace("_", ".") + "$"
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).astype(str).str.match(regex),
+                      f"({self._name} LIKE {pattern})")
+
+    def substr(self, start: int, length: int) -> "Column":
+        return Column(lambda pdf, ctx: self._eval(pdf, ctx).astype(str).str.slice(start - 1, start - 1 + length),
+                      f"substr({self._name}, {start}, {length})")
+
+    # -- cast --
+    def cast(self, to) -> "Column":
+        t: DataType = parse_type(to) if isinstance(to, str) else to
+
+        def ev(pdf, ctx):
+            s = self._eval(pdf, ctx)
+            tn = t.simpleString()
+            if tn in ("double", "float"):
+                out = pd.to_numeric(s, errors="coerce")
+                return out.astype(np.float64 if tn == "double" else np.float32)
+            if tn in ("int", "bigint"):
+                out = pd.to_numeric(s, errors="coerce")
+                # Spark cast truncates toward zero; nulls stay null
+                if out.isna().any():
+                    return np.trunc(out)
+                return out.astype(np.int64 if tn == "bigint" else np.int32)
+            if tn == "boolean":
+                return cast_to_boolean(s)
+            if tn == "string":
+                return s.map(lambda v: None if v is None or (isinstance(v, float) and np.isnan(v)) else str(v))
+            if tn == "timestamp":
+                return pd.to_datetime(s, errors="coerce")
+            return s
+
+        return Column(ev, f"CAST({self._name} AS {t.simpleString()})")
+
+    astype = cast
+
+    # -- when/otherwise chaining: only valid on CaseWhenColumn (functions.when) --
+    def otherwise(self, value) -> "Column":
+        raise TypeError("otherwise() can only follow when(); use functions.when(...)")
+
+    def when(self, condition: "Column", value) -> "Column":
+        raise TypeError("when() chaining can only follow functions.when(...)")
+
+    # -- sort order --
+    def desc(self) -> "Column":
+        return Column(self._eval_fn, self._name, agg=self._agg, sort_desc=True)
+
+    def asc(self) -> "Column":
+        return Column(self._eval_fn, self._name, agg=self._agg, sort_desc=False)
+
+    def __repr__(self):
+        return f"Column<'{self._name}'>"
+
+
+class CaseWhenColumn(Column):
+    """First-match CASE WHEN semantics: a matched branch keeps its value even
+    when that value is null (null is not used as an 'unmatched' marker)."""
+
+    def __init__(self, branches, otherwise_col: Optional["Column"] = None, name=None):
+        self._branches = list(branches)  # [(cond Column, value Column)]
+        self._otherwise = otherwise_col
+        label = name or ("CASE " + " ".join(
+            f"WHEN {c._name} THEN {v._name}" for c, v in self._branches) +
+            (f" ELSE {self._otherwise._name}" if self._otherwise else "") + " END")
+        super().__init__(self._eval_case, label)
+
+    def _eval_case(self, pdf: pd.DataFrame, ctx: EvalContext):
+        result = pd.Series([None] * len(pdf), index=pdf.index, dtype=object)
+        matched = pd.Series(False, index=pdf.index)
+        for cond, val in self._branches:
+            sel = cond._eval(pdf, ctx).fillna(False).astype(bool) & ~matched
+            if sel.any():
+                result[sel] = _as_series(val._eval(pdf, ctx), pdf)[sel]
+            matched |= sel
+        if self._otherwise is not None:
+            rest = ~matched
+            if rest.any():
+                result[rest] = _as_series(self._otherwise._eval(pdf, ctx), pdf)[rest]
+        return result.infer_objects()
+
+    def when(self, condition: "Column", value) -> "CaseWhenColumn":
+        val_c = value if isinstance(value, Column) else LitColumn(value)
+        return CaseWhenColumn(self._branches + [(condition, val_c)], self._otherwise)
+
+    def otherwise(self, value) -> "CaseWhenColumn":
+        other = value if isinstance(value, Column) else LitColumn(value)
+        return CaseWhenColumn(self._branches, other)
+
+
+class NamedColumn(Column):
+    """Reference to an existing column by name."""
+
+    def __init__(self, name: str):
+        if name == "*":
+            raise ValueError("use df.select('*') directly")
+        super().__init__(lambda pdf, ctx: pdf[name], name)
+        self.ref = name
+
+
+class LitColumn(Column):
+    def __init__(self, value: Any):
+        super().__init__(lambda pdf, ctx: _as_series(value, pdf), str(value))
+        self.value = value
+
+
+_TRUE_STRINGS = {"true", "t", "yes", "y", "1"}
+_FALSE_STRINGS = {"false", "f", "no", "n", "0"}
+
+
+def cast_to_boolean(s: pd.Series) -> pd.Series:
+    """SQL cast-to-boolean: recognized string literals map to bool, anything
+    else becomes null; numerics are nonzero-is-true."""
+    if s.dtype.kind in "ifu":
+        return s != 0
+    if s.dtype.kind == "b":
+        return s
+
+    def conv(v):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return None
+        if isinstance(v, (bool, np.bool_)):
+            return bool(v)
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            return v != 0
+        t = str(v).strip().lower()
+        if t in _TRUE_STRINGS:
+            return True
+        if t in _FALSE_STRINGS:
+            return False
+        return None
+
+    return s.map(conv)
+
+
+def ensure_column(x) -> Column:
+    if isinstance(x, Column):
+        return x
+    if isinstance(x, str):
+        return NamedColumn(x)
+    return LitColumn(x)

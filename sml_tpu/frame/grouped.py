@@ -1,0 +1,140 @@
+"""GroupedData: keyed aggregation + per-group pandas training fan-out.
+
+Covers `groupBy().count()/agg(...)` (SURVEY L1) and
+`groupBy(...).applyInPandas(fn, schema)` — the per-group sklearn-training
+path of `SML/ML 13 - Training with Pandas Function API.py:119-161` (P8).
+The shuffle is a Murmur3 hash repartition by key; per-group functions then
+run host-side (the payload is arbitrary Python: sklearn/JAX/etc.), matching
+the reference's executor-side Python workers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Union
+
+import numpy as np
+import pandas as pd
+
+from ..conf import GLOBAL_CONF
+from .column import Column, EvalContext
+from .dataframe import DataFrame, _concat, _hash_repartition, coerce_to_schema
+from .types import StructType, parse_schema
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Column]):
+        self._df = df
+        self._keys = keys
+
+    def _grouped(self):
+        pdf = _concat(self._df._materialize())
+        key_names = [k._name for k in self._keys]
+        for k in self._keys:
+            if k._name not in pdf.columns:
+                pdf[k._name] = k._eval(pdf, EvalContext()).values
+        return pdf, key_names
+
+    def agg(self, *exprs) -> DataFrame:
+        if len(exprs) == 1 and isinstance(exprs[0], dict):
+            from . import functions as F
+            mapping = {"avg": F.avg, "mean": F.avg, "max": F.max, "min": F.min,
+                       "sum": F.sum, "count": F.count, "stddev": F.stddev,
+                       "first": F.first, "last": F.last}
+            exprs = tuple(mapping[op](c) for c, op in exprs[0].items())
+
+        parent = self
+
+        def compute():
+            pdf, key_names = parent._grouped()
+            results: Dict[str, pd.Series] = {}
+            if key_names:
+                gb_index = pdf.groupby(key_names, sort=False, dropna=False)
+            for e in exprs:
+                if e._agg is None:
+                    raise ValueError(f"non-aggregate expression in agg: {e._name}")
+                evaluated = e._eval(pdf, EvalContext()) if len(pdf) else pd.Series(dtype=float)
+                if key_names:
+                    if isinstance(evaluated, pd.DataFrame):
+                        grouped = evaluated.groupby([pdf[k].values for k in key_names],
+                                                    sort=False, dropna=False).apply(e._agg)
+                    else:
+                        grouped = evaluated.groupby([pdf[k].values for k in key_names],
+                                                    sort=False, dropna=False).agg(e._agg)
+                    results[e._name] = grouped
+                else:
+                    results[e._name] = pd.Series([e._agg(evaluated)])
+            if key_names:
+                keys_df = gb_index.size().reset_index()[key_names]
+                out = keys_df.copy()
+                for name, series in results.items():
+                    series = series.reset_index(drop=True)
+                    # align by recomputing group order: pandas groupby(sort=False)
+                    # preserves first-appearance order in both paths
+                    out[name] = series.values
+            else:
+                out = pd.DataFrame({k: v for k, v in results.items()})
+            nparts = GLOBAL_CONF.getInt("sml.shuffle.partitions")
+            if key_names:
+                return _hash_repartition(out, key_names, nparts)
+            return [out]
+
+        return DataFrame(compute, session=self._df._session)
+
+    def count(self) -> DataFrame:
+        from . import functions as F
+        out = self.agg(F.count("*").alias("count"))
+        return out
+
+    def _simple(self, op: str, cols) -> DataFrame:
+        from . import functions as F
+        fns = {"avg": F.avg, "mean": F.avg, "sum": F.sum, "min": F.min, "max": F.max}
+        if not cols:
+            pdf = _concat(self._df._materialize())
+            cols = [c for c in pdf.columns if pdf[c].dtype.kind in "ifu"
+                    and c not in [k._name for k in self._keys]]
+        return self.agg(*[fns[op](c) for c in cols])
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple("avg", cols)
+
+    mean = avg
+
+    def sum(self, *cols) -> DataFrame:  # noqa: A003
+        return self._simple("sum", cols)
+
+    def min(self, *cols) -> DataFrame:  # noqa: A003
+        return self._simple("min", cols)
+
+    def max(self, *cols) -> DataFrame:  # noqa: A003
+        return self._simple("max", cols)
+
+    def applyInPandas(self, fn: Callable[[pd.DataFrame], pd.DataFrame],
+                      schema: Union[str, StructType]) -> DataFrame:
+        """Hash-shuffle by key, run `fn` once per group, enforce schema
+        (`ML 13:119-127`). Group key columns are included in the input block,
+        as in the reference."""
+        sch = parse_schema(schema)
+        parent = self
+
+        def compute():
+            pdf, key_names = parent._grouped()
+            if len(pdf) == 0:
+                return [coerce_to_schema(pd.DataFrame(), sch)]
+            outs = []
+            for _, g in pdf.groupby(key_names, sort=False, dropna=False):
+                res = fn(g.reset_index(drop=True))
+                outs.append(coerce_to_schema(res, sch))
+            full = pd.concat(outs, ignore_index=True)
+            nparts = min(len(outs), GLOBAL_CONF.getInt("sml.shuffle.partitions"))
+            avail = [k for k in key_names if k in full.columns]
+            if avail:
+                return _hash_repartition(full, avail, max(1, nparts))
+            return [full]
+
+        return DataFrame(compute, session=self._df._session, schema=sch)
+
+    def applyInPandasWithState(self, *a, **k):
+        raise NotImplementedError("stateful streaming aggregation is not supported")
+
+    def pivot(self, pivot_col: str, values=None) -> "GroupedData":
+        raise NotImplementedError("pivot is not in the covered course surface")

@@ -1,0 +1,293 @@
+"""DataFrameReader / DataFrameWriter: csv, parquet, json, delta, tables.
+
+Covers the IO surface the course exercises: `spark.read.csv` with
+header/inferSchema/multiLine/escape/sep (`ML 01:34`, `ML 14:85`),
+`read.parquet/json`, `read.format("delta").load` + time-travel options
+(`ML 00c:113,192-209`), and writes with mode/partitionBy/overwriteSchema/
+mergeSchema (`ML 00c:59,78`), `saveAsTable` (`ML 00c:70`), multi-part
+parquet (`Labs/ML 00L:89-90`).
+
+Files are written one part-file per partition (part-00000…​), preserving the
+partition layout contract that seeded randomSplit depends on.
+"""
+
+from __future__ import annotations
+
+import glob
+import json as _json
+import os
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..conf import GLOBAL_CONF
+from .dataframe import DataFrame, _split_rows
+from .types import StructType, parse_schema
+
+
+def _to_bool(v) -> bool:
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self._session = session
+        self._format = "parquet"
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[StructType] = None
+
+    def format(self, source: str) -> "DataFrameReader":  # noqa: A003
+        self._format = source.lower()
+        return self
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **opts) -> "DataFrameReader":
+        self._options.update(opts)
+        return self
+
+    def schema(self, s: Union[str, StructType]) -> "DataFrameReader":
+        self._schema = parse_schema(s)
+        return self
+
+    def load(self, path: Optional[str] = None) -> DataFrame:
+        fmt = self._format
+        if fmt == "delta":
+            from ..delta.table import read_delta
+            return read_delta(path, self._session, self._options)
+        if fmt == "parquet":
+            return self.parquet(path)
+        if fmt == "csv":
+            return self.csv(path)
+        if fmt == "json":
+            return self.json(path)
+        raise ValueError(f"unknown format {fmt}")
+
+    # ------------------------------------------------------------- formats
+    def csv(self, path: str, header: Optional[bool] = None, sep: Optional[str] = None,
+            inferSchema: Optional[bool] = None, multiLine: Optional[bool] = None,
+            escape: Optional[str] = None, schema: Optional[Union[str, StructType]] = None) -> DataFrame:
+        o = self._options
+        header = header if header is not None else _to_bool(o.get("header", False))
+        sep = sep or o.get("sep", o.get("delimiter", ","))
+        infer = inferSchema if inferSchema is not None else _to_bool(o.get("inferSchema", False))
+        escape = escape or o.get("escape", None)
+        if schema is not None:
+            self._schema = parse_schema(schema)
+
+        files = _expand(path, (".csv", ".txt", ".tsv"))
+        kwargs: Dict[str, Any] = {"sep": sep, "header": 0 if header else None}
+        if escape:
+            kwargs["escapechar"] = escape if escape != '"' else None
+            if escape == '"':
+                kwargs["doublequote"] = True
+        if self._schema is not None:
+            kwargs["dtype"] = str  # read raw then coerce to the given schema
+        elif not infer:
+            kwargs["dtype"] = str
+
+        parts: List[pd.DataFrame] = []
+        for f in files:
+            pdf = pd.read_csv(f, **kwargs)
+            if not header:
+                pdf.columns = [f"_c{i}" for i in range(len(pdf.columns))]
+            if self._schema is not None:
+                from .dataframe import coerce_to_schema
+                pdf = coerce_to_schema(pdf, self._schema)
+            parts.append(pdf.reset_index(drop=True))
+        return self._spread(parts)
+
+    def parquet(self, path: str) -> DataFrame:
+        files = _expand(path, (".parquet",))
+        parts = []
+        for f in files:
+            t = pq.read_table(f)
+            parts.append(_arrow_to_pandas(t))
+        return self._spread(parts, split_single=False)
+
+    def json(self, path: str) -> DataFrame:
+        files = _expand(path, (".json",))
+        parts = []
+        for f in files:
+            rows = []
+            with open(f) as fh:
+                text = fh.read().strip()
+            if text.startswith("["):
+                rows = _json.loads(text)
+            else:
+                for line in text.splitlines():
+                    line = line.strip()
+                    if line:
+                        rows.append(_json.loads(line))
+            parts.append(pd.json_normalize(rows, max_level=0))
+        return self._spread(parts)
+
+    def table(self, name: str) -> DataFrame:
+        return self._session.table(name)
+
+    def delta(self, path: str) -> DataFrame:
+        return self.format("delta").load(path)
+
+    def _spread(self, parts: List[pd.DataFrame], split_single: bool = True) -> DataFrame:
+        if not parts:
+            return DataFrame.from_partitions([pd.DataFrame()], session=self._session)
+        if len(parts) == 1 and split_single:
+            return DataFrame.from_pandas(parts[0], session=self._session)
+        return DataFrame.from_partitions(parts, session=self._session)
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._format = "parquet"
+        self._mode = "errorifexists"
+        self._options: Dict[str, Any] = {}
+        self._partition_by: List[str] = []
+
+    def format(self, source: str) -> "DataFrameWriter":  # noqa: A003
+        self._format = source.lower()
+        return self
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m.lower()
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def options(self, **opts) -> "DataFrameWriter":
+        self._options.update(opts)
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def repartition(self, n: int) -> "DataFrameWriter":
+        self._df = self._df.repartition(n)
+        return self
+
+    # -------------------------------------------------------------- targets
+    def save(self, path: str) -> None:
+        if self._format == "delta":
+            from ..delta.table import write_delta
+            write_delta(self._df, path, mode=self._mode, options=self._options,
+                        partition_by=self._partition_by)
+            return
+        if os.path.exists(path):
+            if self._mode in ("error", "errorifexists"):
+                raise FileExistsError(f"path already exists: {path}")
+            if self._mode == "ignore":
+                return
+            if self._mode == "overwrite":
+                import shutil
+                shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+        parts = self._df._materialize()
+        if self._mode == "append":
+            existing = len(glob.glob(os.path.join(path, "part-*")))
+        else:
+            existing = 0
+        if self._partition_by:
+            self._save_partitioned(path, parts)
+            return
+        for i, p in enumerate(parts):
+            name = f"part-{existing + i:05d}"
+            if self._format == "parquet":
+                pq.write_table(_pandas_to_arrow(p), os.path.join(path, name + ".snappy.parquet"))
+            elif self._format == "csv":
+                p.to_csv(os.path.join(path, name + ".csv"), index=False,
+                         header=_to_bool(self._options.get("header", False)))
+            elif self._format == "json":
+                p.to_json(os.path.join(path, name + ".json"), orient="records", lines=True)
+            else:
+                raise ValueError(f"unknown format {self._format}")
+        open(os.path.join(path, "_SUCCESS"), "w").close()
+
+    def _save_partitioned(self, path: str, parts) -> None:
+        import uuid
+        from .dataframe import _concat
+        pdf = _concat(parts)
+        for keys, g in pdf.groupby(self._partition_by, sort=False, dropna=False):
+            if not isinstance(keys, tuple):
+                keys = (keys,)
+            sub = os.path.join(path, *[f"{k}={v}" for k, v in zip(self._partition_by, keys)])
+            os.makedirs(sub, exist_ok=True)
+            body = g.drop(columns=self._partition_by).reset_index(drop=True)
+            # unique part name so append mode never clobbers existing files
+            pq.write_table(_pandas_to_arrow(body),
+                           os.path.join(sub, f"part-{uuid.uuid4().hex[:12]}.snappy.parquet"))
+        open(os.path.join(path, "_SUCCESS"), "w").close()
+
+    def parquet(self, path: str, mode: Optional[str] = None) -> None:
+        if mode:
+            self._mode = mode.lower()
+        self.format("parquet").save(path)
+
+    def csv(self, path: str, mode: Optional[str] = None, header: bool = False) -> None:
+        if mode:
+            self._mode = mode.lower()
+        self._options.setdefault("header", header)
+        self.format("csv").save(path)
+
+    def json(self, path: str, mode: Optional[str] = None) -> None:
+        if mode:
+            self._mode = mode.lower()
+        self.format("json").save(path)
+
+    def delta(self, path: str) -> None:
+        self.format("delta").save(path)
+
+    def saveAsTable(self, name: str) -> None:
+        session = self._df._session
+        if session is None:
+            raise RuntimeError("no session")
+        path = session.catalog._table_path(name)
+        self.save(path)
+        session.catalog._register_table(name, path, self._format)
+
+
+def _expand(path: str, exts) -> List[str]:
+    """Path may be a file, a directory of part-files, or a glob."""
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                if f.startswith(("_", ".")):
+                    continue
+                if any(f.endswith(e) for e in exts) or "." not in f:
+                    out.append(os.path.join(root, f))
+        if out:
+            return out
+        raise FileNotFoundError(f"no data files under {path}")
+    hits = sorted(glob.glob(path))
+    if hits:
+        return hits
+    raise FileNotFoundError(path)
+
+
+def _arrow_to_pandas(t: pa.Table) -> pd.DataFrame:
+    pdf = t.to_pandas()
+    # list<float> columns come back as numpy arrays per row → keep as object
+    return pdf.reset_index(drop=True)
+
+
+def _pandas_to_arrow(pdf: pd.DataFrame) -> pa.Table:
+    cols = {}
+    for c in pdf.columns:
+        s = pdf[c]
+        if s.dtype == object and len(s) and s.map(
+                lambda v: isinstance(v, (list, np.ndarray)), na_action="ignore").fillna(False).any():
+            cols[c] = pa.array([None if v is None else list(np.asarray(v, dtype=np.float32))
+                                for v in s], type=pa.list_(pa.float32()))
+        else:
+            cols[c] = pa.array(s)
+    return pa.table(cols)

@@ -1,0 +1,252 @@
+"""TpuSession — the SparkSession equivalent (single-process driver, no JVM).
+
+The Py4J bridge disappears (SURVEY §2.3): one Python driver owns the Arrow
+host tables, the catalog (temp views + warehouse tables), the conf, and the
+device mesh. `spark.` call-sites in the course map 1:1 onto this class.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from ..conf import GLOBAL_CONF, TpuConf
+from .dataframe import DataFrame
+from .types import Row, StructType, parse_schema
+
+
+class Catalog:
+    def __init__(self, session: "TpuSession", warehouse: str):
+        self._session = session
+        self._warehouse = warehouse
+        self._views_reg: Dict[str, DataFrame] = {}
+        self._tables_reg: Dict[str, Tuple[str, str]] = {}  # name -> (path, fmt)
+        self._databases = {"default"}
+        self._current_db = "default"
+
+    # views
+    def _register_view(self, name: str, df: DataFrame) -> None:
+        self._views_reg[name] = df
+
+    def _views(self) -> Dict[str, DataFrame]:
+        return dict(self._views_reg)
+
+    def dropTempView(self, name: str) -> bool:
+        return self._views_reg.pop(name, None) is not None
+
+    def tableExists(self, name: str) -> bool:
+        return name in self._views_reg or self._qualify(name) in self._tables_reg
+
+    def listTables(self):
+        return [Row(database=d, tableName=t, isTemporary=tmp)
+                for d, t, tmp in self._list_tables()]
+
+    def _list_tables(self):
+        out = [("", v, True) for v in self._views_reg]
+        for fq in self._tables_reg:
+            db, _, t = fq.rpartition(".")
+            out.append((db or "default", t, False))
+        return out
+
+    # databases
+    def _create_database(self, name: str) -> None:
+        self._databases.add(name)
+        os.makedirs(os.path.join(self._warehouse, name + ".db"), exist_ok=True)
+
+    def _drop_database(self, name: str) -> None:
+        self._databases.discard(name)
+        for fq in [k for k in self._tables_reg if k.startswith(name + ".")]:
+            self._tables_reg.pop(fq)
+        shutil.rmtree(os.path.join(self._warehouse, name + ".db"), ignore_errors=True)
+
+    def _use_database(self, name: str) -> None:
+        self._databases.add(name)
+        self._current_db = name
+
+    def currentDatabase(self) -> str:
+        return self._current_db
+
+    # tables
+    def _qualify(self, name: str) -> str:
+        return name if "." in name else f"{self._current_db}.{name}"
+
+    def _table_path(self, name: str) -> str:
+        fq = self._qualify(name)
+        db, _, t = fq.rpartition(".")
+        return os.path.join(self._warehouse, db + ".db", t)
+
+    def _register_table(self, name: str, path: str, fmt: str) -> None:
+        self._tables_reg[self._qualify(name)] = (path, fmt)
+
+    def _drop_table(self, name: str) -> None:
+        fq = self._qualify(name)
+        info = self._tables_reg.pop(fq, None)
+        if info:
+            shutil.rmtree(info[0], ignore_errors=True)
+
+    def _tables(self) -> Dict[str, Tuple[str, str]]:
+        return dict(self._tables_reg)
+
+
+class _Builder:
+    def __init__(self):
+        self._app = "sml_tpu"
+        self._conf: Dict[str, Any] = {}
+
+    def appName(self, name: str) -> "_Builder":
+        self._app = name
+        return self
+
+    def master(self, _m: str) -> "_Builder":
+        return self
+
+    def config(self, key: str, value) -> "_Builder":
+        self._conf[key] = value
+        return self
+
+    def enableHiveSupport(self) -> "_Builder":
+        return self
+
+    def getOrCreate(self) -> "TpuSession":
+        s = TpuSession._instance or TpuSession(app_name=self._app)
+        for k, v in self._conf.items():
+            s.conf.set(k, v)
+        return s
+
+
+class TpuSession:
+    _instance: Optional["TpuSession"] = None
+
+    def __init__(self, app_name: str = "sml_tpu", warehouse: Optional[str] = None):
+        self.app_name = app_name
+        self.conf: TpuConf = GLOBAL_CONF
+        self._warehouse = warehouse or os.path.join(tempfile.gettempdir(), "sml_tpu_warehouse")
+        os.makedirs(self._warehouse, exist_ok=True)
+        self.catalog = Catalog(self, self._warehouse)
+        TpuSession._instance = self
+
+    builder = None  # set below
+
+    @classmethod
+    def getActiveSession(cls) -> Optional["TpuSession"]:
+        return cls._instance
+
+    # ------------------------------------------------------------- creation
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: Optional[int] = None) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        ids = np.arange(start, end, step, dtype=np.int64)
+        pdf = pd.DataFrame({"id": ids})
+        return DataFrame.from_pandas(pdf, session=self, num_partitions=numPartitions)
+
+    def createDataFrame(self, data, schema: Optional[Union[str, StructType, List[str]]] = None,
+                        numPartitions: Optional[int] = None) -> DataFrame:
+        if isinstance(data, pd.DataFrame):
+            pdf = data.copy()
+            if isinstance(schema, list):
+                pdf.columns = schema
+        else:
+            rows = list(data)
+            if rows and isinstance(rows[0], Row):
+                pdf = pd.DataFrame([r.asDict() for r in rows])
+            elif rows and isinstance(rows[0], dict):
+                pdf = pd.DataFrame(rows)
+            else:
+                if isinstance(schema, list):
+                    pdf = pd.DataFrame(rows, columns=schema)
+                elif isinstance(schema, (str, StructType)):
+                    st = parse_schema(schema)
+                    pdf = pd.DataFrame(rows, columns=st.names)
+                else:
+                    pdf = pd.DataFrame(rows, columns=[f"_{i+1}" for i in range(len(rows[0]))])
+        st = parse_schema(schema) if isinstance(schema, (str, StructType)) else None
+        if st is not None:
+            from .dataframe import coerce_to_schema
+            pdf = coerce_to_schema(pdf, st)
+        return DataFrame.from_pandas(pdf, session=self, num_partitions=numPartitions, schema=st)
+
+    # --------------------------------------------------------------- access
+    @property
+    def read(self):
+        from .io import DataFrameReader
+        return DataFrameReader(self)
+
+    @property
+    def readStream(self):
+        from ..streaming.stream import DataStreamReader
+        return DataStreamReader(self)
+
+    def table(self, name: str) -> DataFrame:
+        views = self.catalog._views()
+        if name in views:
+            return views[name]
+        fq = self.catalog._qualify(name)
+        info = self.catalog._tables().get(fq)
+        if info is None:
+            # fall back to a directory in the warehouse (created by saveAsTable
+            # in an earlier session)
+            path = self.catalog._table_path(name)
+            if os.path.isdir(os.path.join(path, "_delta_log")):
+                info = (path, "delta")
+            elif os.path.isdir(path):
+                info = (path, "parquet")
+            else:
+                raise ValueError(f"Table or view not found: {name}")
+        path, fmt = info
+        if fmt == "delta":
+            from ..delta.table import read_delta
+            return read_delta(path, self, {})
+        return self.read.format(fmt).load(path)
+
+    def sql(self, query: str) -> DataFrame:
+        from .sql import run_sql
+        return run_sql(self, query)
+
+    @property
+    def sparkContext(self):
+        return _ContextShim(self)
+
+    @property
+    def streams(self):
+        from ..streaming.stream import StreamManager
+        return StreamManager()
+
+    def stop(self) -> None:
+        TpuSession._instance = None
+
+    # ---------------------------------------------------------------- misc
+    @property
+    def version(self) -> str:
+        from ..version import __version__
+        return __version__
+
+
+class _ContextShim:
+    """`spark.sparkContext` knobs the course touches."""
+
+    def __init__(self, session: TpuSession):
+        self._session = session
+
+    @property
+    def defaultParallelism(self) -> int:
+        return GLOBAL_CONF.getInt("sml.default.parallelism")
+
+    def setLogLevel(self, _level: str) -> None:
+        pass
+
+    def parallelize(self, data, numSlices: Optional[int] = None):
+        return self._session.createDataFrame(pd.DataFrame({"value": list(data)}),
+                                             numPartitions=numSlices)
+
+
+TpuSession.builder = _Builder()
+
+
+def get_session() -> TpuSession:
+    return TpuSession._instance or TpuSession()

@@ -1,0 +1,177 @@
+"""Minimal SQL shim: temp views + the SELECT subset the course drives.
+
+`createOrReplaceTempView` + `spark.sql`/`%sql` usage (`ML 00b:59-64`,
+`MLE 01:240-251`) runs against an in-memory sqlite database into which the
+referenced views are materialized — an honest host-side fallback: SQL in the
+reference is a convenience layer, never the hot path. DDL-ish statements the
+course needs (CREATE/DROP DATABASE, USE, DESCRIBE HISTORY, SELECT from
+``delta.`path``` ) are routed explicitly.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+from typing import TYPE_CHECKING
+
+import numpy as np
+import pandas as pd
+
+from .column import Column, NamedColumn
+
+if TYPE_CHECKING:
+    from .session import TpuSession
+
+
+class _ExprNamespace(dict):
+    """Identifier → NamedColumn / function resolution for expression strings."""
+
+    def __missing__(self, key):
+        from . import functions as F
+        fn = getattr(F, key, None)
+        if fn is not None and not key.startswith("_"):
+            return fn
+        return NamedColumn(key)
+
+
+def parse_simple_expr(expr: str) -> Column:
+    """Translate a SQL-ish expression ('price > 0 AND bedrooms = 2',
+    'log(price) as log_price') into a Column via restricted eval."""
+    s = expr.strip()
+    alias = None
+    m = re.search(r"\s+[aA][sS]\s+([A-Za-z_][A-Za-z0-9_]*)\s*$", s)
+    if m:
+        alias = m.group(1)
+        s = s[:m.start()]
+    # SQL → Python operator translation
+    s = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
+    s = re.sub(r"<>", "!=", s)
+    s = re.sub(r"\bAND\b", "&", s, flags=re.I)
+    s = re.sub(r"\bOR\b", "|", s, flags=re.I)
+    s = re.sub(r"\bNOT\b", "~", s, flags=re.I)
+    s = re.sub(r"\bIS\s+~\s*NULL\b", ".isNotNull()", s, flags=re.I)
+    s = re.sub(r"\bIS\s+NULL\b", ".isNull()", s, flags=re.I)
+    s = re.sub(r"`([^`]*)`", r"col('\1')", s)
+    # Parenthesize comparison clauses joined by top-level &/| so Python's
+    # operator precedence (& binds tighter than >=) doesn't bite.
+    s = _parenthesize_clauses(s)
+    col_ns = _ExprNamespace()
+    out = eval(s, {"__builtins__": {}}, col_ns)  # noqa: S307 - restricted ns
+    if not isinstance(out, Column):
+        from .column import LitColumn
+        out = LitColumn(out)
+    if alias:
+        out = out.alias(alias)
+    return out
+
+
+def _parenthesize_clauses(s: str) -> str:
+    """Split on top-level & / | and wrap each clause in parens."""
+    parts, ops = [], []
+    depth, start = 0, 0
+    for i, ch in enumerate(s):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch in "&|" and depth == 0:
+            parts.append(s[start:i])
+            ops.append(ch)
+            start = i + 1
+    parts.append(s[start:])
+    if not ops:
+        return s
+    out = f"({parts[0].strip()})"
+    for op, p in zip(ops, parts[1:]):
+        out += f" {op} ({p.strip()})"
+    return out
+
+
+_DELTA_REF = re.compile(r"delta\.`([^`]+)`", re.I)
+
+
+def run_sql(session: "TpuSession", query: str):
+    from .dataframe import DataFrame
+
+    q = query.strip().rstrip(";")
+    ql = q.lower()
+
+    # --- DDL / catalog statements -----------------------------------------
+    m = re.match(r"create\s+database\s+(if\s+not\s+exists\s+)?([\w`]+)(\s+location\s+'([^']*)')?",
+                 ql)
+    if m:
+        name = re.match(r"create\s+database\s+(?:if\s+not\s+exists\s+)?([\w`]+)", q,
+                        re.I).group(1).strip("`")
+        session.catalog._create_database(name)
+        return _empty(session)
+    m = re.match(r"drop\s+database\s+(if\s+exists\s+)?([\w`]+)(\s+cascade)?", ql)
+    if m:
+        name = re.match(r"drop\s+database\s+(?:if\s+exists\s+)?([\w`]+)", q, re.I).group(1).strip("`")
+        session.catalog._drop_database(name)
+        return _empty(session)
+    if ql.startswith("use "):
+        session.catalog._use_database(q.split()[-1].strip("`"))
+        return _empty(session)
+    if ql.startswith("drop table"):
+        name = q.split()[-1].strip("`")
+        session.catalog._drop_table(name)
+        return _empty(session)
+    if ql.startswith("show tables"):
+        rows = [{"database": d, "tableName": t, "isTemporary": tmp}
+                for d, t, tmp in session.catalog._list_tables()]
+        return DataFrame.from_pandas(pd.DataFrame(rows), session=session, num_partitions=1)
+    m = re.match(r"describe\s+history\s+(.*)", q, re.I)
+    if m:
+        from ..delta.table import DeltaTable
+        target = m.group(1).strip()
+        dm = _DELTA_REF.match(target)
+        path = dm.group(1) if dm else session.catalog._table_path(target.strip("`"))
+        return DeltaTable.forPath(session, path).history()
+    m = re.match(r"describe\s+(detail\s+)?(.*)", q, re.I)
+    if m and not ql.startswith("describe select"):
+        target = m.group(2).strip().strip("`")
+        df = session.table(target)
+        rows = [{"col_name": n, "data_type": t, "comment": None} for n, t in df.dtypes]
+        return DataFrame.from_pandas(pd.DataFrame(rows), session=session, num_partitions=1)
+
+    # --- SELECT via sqlite -------------------------------------------------
+    con = sqlite3.connect(":memory:")
+    try:
+        # Materialize delta.`path` references as temp tables.
+        def repl(m_):
+            path = m_.group(1)
+            tbl = "_delta_" + re.sub(r"\W", "_", path)
+            from ..delta.table import read_delta
+            _to_sqlite(read_delta(path, session, {}).toPandas(), tbl, con)
+            return tbl
+
+        q2 = _DELTA_REF.sub(repl, q)
+
+        for name, df in session.catalog._views().items():
+            if re.search(rf"\b{re.escape(name)}\b", q2, re.I):
+                _to_sqlite(df.toPandas(), name, con)
+        for fqname, (path, fmt) in session.catalog._tables().items():
+            short = fqname.split(".")[-1]
+            for candidate in (fqname, short):
+                if re.search(rf"\b{re.escape(candidate)}\b", q2, re.I):
+                    _to_sqlite(session.table(fqname).toPandas(), candidate.replace(".", "_"), con)
+                    q2 = re.sub(rf"\b{re.escape(candidate)}\b", candidate.replace(".", "_"), q2)
+                    break
+        res = pd.read_sql_query(q2, con)
+        return DataFrame.from_pandas(res, session=session)
+    finally:
+        con.close()
+
+
+def _to_sqlite(pdf: pd.DataFrame, name: str, con) -> None:
+    safe = pdf.copy()
+    for c in safe.columns:
+        if safe[c].dtype == object:
+            safe[c] = safe[c].map(
+                lambda v: str(v) if isinstance(v, (list, np.ndarray, dict)) else v)
+    safe.to_sql(name, con, index=False, if_exists="replace")
+
+
+def _empty(session):
+    from .dataframe import DataFrame
+    return DataFrame.from_pandas(pd.DataFrame(), session=session, num_partitions=1)

@@ -1,0 +1,4 @@
+from .build import load_library
+from .hashing import hash_columns, hash_partition_ids, hash_scalar
+
+__all__ = ["load_library", "hash_columns", "hash_partition_ids", "hash_scalar"]

@@ -1,0 +1,50 @@
+"""On-demand build + ctypes loader for the native host-side kernels.
+
+The reference's host-side native layer (Spark JVM shuffle machinery, Arrow
+C++) is replaced by small C++ kernels compiled here with g++ on first use and
+cached under ``native/build/``. Everything is gated: if no compiler is
+available the callers fall back to NumPy implementations with identical
+semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "build")
+_LOCK = threading.RLock()
+_LIBS: dict = {}
+_FAILED: set = set()
+
+
+def _so_path(name: str) -> str:
+    return os.path.join(_BUILD_DIR, f"lib{name}.so")
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and load ``native/<name>.cc``; None on failure."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        if name in _FAILED:
+            return None
+        src = os.path.join(_HERE, f"{name}.cc")
+        so = _so_path(name)
+        try:
+            if (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                     "-std=c++17", src, "-o", so],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            _FAILED.add(name)
+            return None
+        _LIBS[name] = lib
+        return lib

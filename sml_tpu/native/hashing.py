@@ -1,0 +1,187 @@
+"""Spark-semantics Murmur3 hashing: ctypes fast path + NumPy fallback.
+
+Drives the `hash()` column function (`SML/Includes/Class-Utility-Methods.py:
+161-165`), hash-partition shuffles, and dropDuplicates partition assignment.
+Multi-column hashing chains: running hash starts at seed 42 and each column's
+hash uses the previous as its seed; nulls leave the running hash unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, Optional
+
+import numpy as np
+import pandas as pd
+
+from .build import load_library
+
+SEED = 42
+_M32 = np.uint32(0xFFFFFFFF)
+
+
+def _modular(fn):
+    """uint32 arithmetic here is intentionally modular — silence overflow
+    warnings locally without touching global numpy error state."""
+    def wrapped(*args, **kwargs):
+        with np.errstate(over="ignore"):
+            return fn(*args, **kwargs)
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+# ---------- vectorized NumPy implementation (fallback + reference) ----------
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return ((x << np.uint32(r)) | (x >> np.uint32(32 - r))).astype(np.uint32)
+
+
+def _mix_k1(k1: np.ndarray) -> np.ndarray:
+    k1 = (k1 * np.uint32(0xCC9E2D51)).astype(np.uint32)
+    k1 = _rotl32(k1, 15)
+    return (k1 * np.uint32(0x1B873593)).astype(np.uint32)
+
+
+def _mix_h1(h1: np.ndarray, k1: np.ndarray) -> np.ndarray:
+    h1 = (h1 ^ k1).astype(np.uint32)
+    h1 = _rotl32(h1, 13)
+    return (h1 * np.uint32(5) + np.uint32(0xE6546B64)).astype(np.uint32)
+
+
+def _fmix(h1: np.ndarray, length) -> np.ndarray:
+    h1 = (h1 ^ np.uint32(length)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(13)
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def _np_hash_int(vals: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    k1 = _mix_k1(vals.astype(np.int32).view(np.uint32))
+    h1 = _mix_h1(seeds.view(np.uint32), k1)
+    return _fmix(h1, 4).view(np.int32)
+
+
+def _np_hash_long(vals: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    v = vals.astype(np.int64).view(np.uint64)
+    low = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    high = (v >> np.uint64(32)).astype(np.uint32)
+    h1 = _mix_h1(seeds.view(np.uint32), _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, 8).view(np.int32)
+
+
+def _np_hash_double(vals: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    d = vals.astype(np.float64).copy()
+    d[d == 0.0] = 0.0  # normalize -0.0
+    return _np_hash_long(d.view(np.int64), seeds)
+
+
+@_modular
+def _py_hash_bytes(data: bytes, seed: int) -> int:
+    h1 = np.uint32(seed & 0xFFFFFFFF)
+    n = len(data)
+    aligned = n - (n & 3)
+    for i in range(0, aligned, 4):
+        word = np.uint32(int.from_bytes(data[i:i + 4], "little"))
+        h1 = _mix_h1(h1, _mix_k1(word))
+    for i in range(aligned, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign-extend
+        h1 = _mix_h1(h1, _mix_k1(np.uint32(b & 0xFFFFFFFF)))
+    return int(_fmix(h1, n).view(np.int32))
+
+
+# ------------------------------- public API --------------------------------
+
+def _lib():
+    return load_library("murmur3")
+
+
+@_modular
+def hash_column(values, seeds: np.ndarray) -> np.ndarray:
+    """Chain one column into running int32 hashes (`seeds`), Spark-style."""
+    n = len(seeds)
+    out = seeds.astype(np.int32).copy()
+    s = pd.Series(values) if not isinstance(values, pd.Series) else values
+    nulls = s.isna().to_numpy()
+
+    kind = s.dtype.kind
+    if kind in "iu":
+        vals = s.to_numpy()
+        # int32-or-smaller hashes as int; larger as long
+        if s.dtype.itemsize <= 4:
+            res = _np_hash_int(vals.astype(np.int32), out)
+        else:
+            res = _np_hash_long(vals.astype(np.int64), out)
+        out[~nulls] = res[~nulls]
+        return out
+    if kind == "b":
+        vals = s.fillna(False).to_numpy().astype(np.int32)
+        res = _np_hash_int(vals, out)
+        out[~nulls] = res[~nulls]
+        return out
+    if kind == "f":
+        vals = s.fillna(0.0).to_numpy().astype(np.float64)
+        if s.dtype.itemsize <= 4:
+            v32 = vals.astype(np.float32)
+            v32[v32 == 0.0] = 0.0
+            res = _np_hash_int(v32.view(np.int32), out)
+        else:
+            res = _np_hash_double(vals, out)
+        out[~nulls] = res[~nulls]
+        return out
+
+    # strings / objects → utf8 bytes
+    lib = _lib()
+    if lib is not None:
+        bufs = []
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        for i, v in enumerate(s):
+            b = b"" if (nulls[i] or v is None) else str(v).encode("utf-8")
+            bufs.append(b)
+            offsets[i + 1] = offsets[i] + len(b)
+        blob = b"".join(bufs)
+        null_arr = nulls.astype(np.uint8)
+        blob_buf = (ctypes.c_uint8 * max(len(blob), 1)).from_buffer_copy(blob or b"\x00")
+        lib.mm3_hash_bytes_arr(
+            blob_buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            null_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
+    for i, v in enumerate(s):
+        if nulls[i] or v is None:
+            continue
+        out[i] = _py_hash_bytes(str(v).encode("utf-8"), int(out[i]))
+    return out
+
+
+@_modular
+def hash_columns(columns: Iterable, n: Optional[int] = None, seed: int = SEED) -> np.ndarray:
+    """Hash rows across columns with seed chaining (the `hash(*cols)` op)."""
+    cols = list(columns)
+    if n is None:
+        n = len(cols[0])
+    out = np.full(n, seed, dtype=np.int32)
+    for c in cols:
+        out = hash_column(c, out)
+    return out
+
+
+def hash_partition_ids(hashes: np.ndarray, num_parts: int) -> np.ndarray:
+    """pmod(hash, num_parts) — shuffle placement."""
+    m = hashes.astype(np.int64) % num_parts
+    return m.astype(np.int32)
+
+
+@_modular
+def hash_scalar(value, seed: int = SEED) -> int:
+    """Hash one Python scalar (harness `toHash` equivalent)."""
+    arr = hash_columns([pd.Series([value])], n=1, seed=seed)
+    return int(arr[0])

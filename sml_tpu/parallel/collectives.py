@@ -1,0 +1,79 @@
+"""The single communication backend (SURVEY §2.4).
+
+Spark's shuffle, `treeAggregate`, Arrow IPC and XGBoost's Rabit allreduce all
+collapse into XLA collectives over ICI (intra-slice) / DCN (multi-host):
+
+- ``treeAggregate(gradient | Gram)``  → ``psum``            (allreduce)
+- shuffle for keyed aggregation       → ``all_to_all`` on device, or the
+  host-side Arrow repartition in ``sml_tpu.frame`` for string-heavy ops
+- broadcast of models/params          → replication via sharding
+- Rabit histogram allreduce           → the same ``psum``
+
+These wrappers exist so estimator code never spells a raw `lax` collective —
+one place to retarget if the axis naming or multi-host story changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import DATA_AXIS
+
+
+def psum(x, axis: str = DATA_AXIS):
+    """Allreduce-sum over the mesh axis — the `treeAggregate` replacement."""
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str = DATA_AXIS):
+    return lax.pmean(x, axis_name=axis)
+
+
+def pmax(x, axis: str = DATA_AXIS):
+    return lax.pmax(x, axis_name=axis)
+
+
+def pmin(x, axis: str = DATA_AXIS):
+    return lax.pmin(x, axis_name=axis)
+
+
+def all_gather(x, axis: str = DATA_AXIS, *, tiled: bool = False):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = DATA_AXIS, *, scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def all_to_all(x, axis: str = DATA_AXIS, *, split_axis: int = 0, concat_axis: int = 0):
+    """Device-side shuffle: exchange row blocks between chips over ICI."""
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, perm, axis: str = DATA_AXIS):
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str = DATA_AXIS):
+    return lax.axis_index(axis_name=axis)
+
+
+def masked_count(mask, axis: str = DATA_AXIS):
+    """Global true-row count given a per-shard 0/1 row mask."""
+    return psum(jnp.sum(mask), axis)
+
+
+def initialize_multihost(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Cross-host (DCN) bring-up. On a single host this is a no-op; on a pod
+    slice it wires `jax.distributed` so the same named collectives span hosts
+    (the NCCL/MPI-equivalent bootstrap, without either)."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

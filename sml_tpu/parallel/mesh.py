@@ -1,0 +1,125 @@
+"""Device-mesh runtime: the substrate every distributed op rides on.
+
+The reference scales by Spark row-partitions over executors (SURVEY §2.2 P1);
+here rows shard over a `jax.sharding.Mesh` of TPU chips and every aggregation
+becomes an XLA collective over ICI (SURVEY §2.4). This module owns mesh
+construction (real chips or a virtual host-CPU mesh for tests), default axis
+naming, and row-sharded staging of host arrays into HBM.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"    # row / batch parallelism (Spark partitions → chips)
+MODEL_AXIS = "model"  # feature/block parallelism (Gram blocks, ALS factors)
+
+_lock = threading.RLock()
+_active_mesh: Optional[Mesh] = None
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a mesh over available devices.
+
+    1-D ``(data,)`` by default. For 2-D meshes pass ``axis_names=("data",
+    "model")`` and optionally an explicit ``shape``; otherwise all devices go
+    on the first axis.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        shape = [n] + [1] * (len(axis_names) - 1)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def get_mesh() -> Mesh:
+    """Return the active mesh, building a default 1-D mesh on first use."""
+    global _active_mesh
+    with _lock:
+        if _active_mesh is None:
+            _active_mesh = build_mesh()
+        return _active_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _active_mesh
+    with _lock:
+        _active_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    """Temporarily swap the active mesh (tests, dryruns)."""
+    global _active_mesh
+    with _lock:
+        prev = _active_mesh
+        _active_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        with _lock:
+            _active_mesh = prev
+
+
+def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
+    """Rows sharded over DATA_AXIS, everything else replicated."""
+    mesh = mesh or get_mesh()
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(x: np.ndarray, multiple: int, fill: float = 0.0) -> Tuple[np.ndarray, int]:
+    """Pad axis 0 to a multiple so row-sharding divides evenly (static shapes —
+    XLA requires equal per-chip blocks; the pad tail is masked by callers)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width, constant_values=fill), n
+
+
+def shard_rows(x: np.ndarray, mesh: Optional[Mesh] = None) -> Tuple[jax.Array, int]:
+    """Stage a host array into HBM sharded by rows over DATA_AXIS.
+
+    Returns (device_array, true_row_count); rows are zero-padded to a
+    per-chip-equal block, callers mask with the true count.
+    """
+    mesh = mesh or get_mesh()
+    n_dev = mesh.shape[DATA_AXIS]
+    padded, n_true = pad_rows(np.asarray(x), n_dev)
+    arr = jax.device_put(padded, data_sharding(mesh, padded.ndim))
+    return arr, n_true
+
+
+def row_mask(n_padded: int, n_true: int, dtype=np.float32) -> np.ndarray:
+    """Host-side 0/1 mask for padded rows (shard alongside the data)."""
+    m = np.zeros((n_padded,), dtype=dtype)
+    m[:n_true] = 1
+    return m
+
+
+def mesh_device_count(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return math.prod(mesh.devices.shape)

@@ -1,0 +1,3 @@
+from .stream import DataStreamReader, DataStreamWriter, StreamingQuery
+
+__all__ = ["DataStreamReader", "DataStreamWriter", "StreamingQuery"]

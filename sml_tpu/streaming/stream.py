@@ -1,0 +1,320 @@
+"""Micro-batch structured streaming (the MLE 00 deployment path, P10).
+
+`spark.readStream.schema(s).option("maxFilesPerTrigger", 1).parquet(dir)` →
+`pipeline_model.transform(stream)` → `writeStream.format("memory"|"delta")
+.option("checkpointLocation", …).outputMode("append").queryName(n).start()`
+(`SML/ML Electives/MLE 00 - MLlib Deployment Options.py:52-85`).
+
+Design: a StreamingDataFrame is a source spec + a chain of DataFrame→
+DataFrame ops (recorded generically, so *any* batch transformation —
+including a fitted PipelineModel — composes). A StreamingQuery runs a
+host-side trigger loop: discover unseen files (the processed-set lives in
+checkpointLocation for crash recovery), build a static DataFrame per batch,
+apply the op chain (TPU inference inside), append to the sink.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import pandas as pd
+
+from ..frame.dataframe import DataFrame
+from ..frame.types import StructType, parse_schema
+
+_active_queries: List["StreamingQuery"] = []
+_lock = threading.RLock()
+
+
+class StreamManager:
+    """`spark.streams` — lifecycle management used by Classroom-Setup
+    (`SML/Includes/Classroom-Setup.py:96-110`)."""
+
+    @property
+    def active(self) -> List["StreamingQuery"]:
+        with _lock:
+            return [q for q in _active_queries if q.isActive]
+
+    def get(self, query_id: str) -> Optional["StreamingQuery"]:
+        for q in self.active:
+            if q.id == query_id or q.name == query_id:
+                return q
+        return None
+
+    def awaitAnyTermination(self, timeout: Optional[float] = None) -> None:
+        t0 = time.time()
+        while self.active:
+            if timeout is not None and time.time() - t0 > timeout:
+                return
+            time.sleep(0.05)
+
+
+class DataStreamReader:
+    def __init__(self, session):
+        self._session = session
+        self._schema: Optional[StructType] = None
+        self._options: Dict[str, Any] = {}
+        self._format = "parquet"
+
+    def schema(self, s: Union[str, StructType]) -> "DataStreamReader":
+        self._schema = parse_schema(s)
+        return self
+
+    def option(self, key: str, value) -> "DataStreamReader":
+        self._options[key] = value
+        return self
+
+    def format(self, f: str) -> "DataStreamReader":  # noqa: A003
+        self._format = f.lower()
+        return self
+
+    def parquet(self, path: str) -> "StreamingDataFrame":
+        return StreamingDataFrame(self._session, path, "parquet", self._schema, self._options)
+
+    def csv(self, path: str) -> "StreamingDataFrame":
+        return StreamingDataFrame(self._session, path, "csv", self._schema, self._options)
+
+    def load(self, path: str) -> "StreamingDataFrame":
+        return StreamingDataFrame(self._session, path, self._format, self._schema, self._options)
+
+
+class StreamingDataFrame:
+    """Unbounded DataFrame: source + recorded batch ops. Any DataFrame method
+    called on it is recorded and replayed per micro-batch."""
+
+    isStreaming = True
+
+    def __init__(self, session, path: str, fmt: str, schema: Optional[StructType],
+                 options: Dict[str, Any],
+                 ops: Optional[List[Callable[[DataFrame], DataFrame]]] = None):
+        self._session = session
+        self._path = path
+        self._fmt = fmt
+        self._schema = schema
+        self._options = options
+        self._ops = ops or []
+
+    def _append(self, op: Callable[[DataFrame], DataFrame]) -> "StreamingDataFrame":
+        return StreamingDataFrame(self._session, self._path, self._fmt, self._schema,
+                                  self._options, self._ops + [op])
+
+    def __getattr__(self, item):
+        if item.startswith("_") or item in ("writeStream",):
+            raise AttributeError(item)
+
+        def recorder(*args, **kwargs):
+            def op(df: DataFrame) -> DataFrame:
+                out = getattr(df, item)(*args, **kwargs)
+                if not isinstance(out, DataFrame):
+                    raise TypeError(f"streaming op {item} must return a DataFrame")
+                return out
+            return self._append(op)
+
+        return recorder
+
+    @property
+    def writeStream(self) -> "DataStreamWriter":
+        return DataStreamWriter(self)
+
+    # -- source side --
+    def _list_files(self) -> List[str]:
+        exts = {"parquet": ".parquet", "csv": ".csv"}[self._fmt]
+        if os.path.isdir(self._path):
+            out = []
+            for root, _d, files in os.walk(self._path):
+                for f in sorted(files):
+                    if f.endswith(exts) and not f.startswith(("_", ".")):
+                        out.append(os.path.join(root, f))
+            return sorted(out)
+        return sorted(glob.glob(self._path))
+
+    def _read_files(self, files: List[str]) -> DataFrame:
+        reader = self._session.read
+        if self._schema is not None:
+            reader = reader.schema(self._schema)
+        import pyarrow.parquet as pq
+        parts = []
+        for f in files:
+            if self._fmt == "parquet":
+                parts.append(pq.read_table(f).to_pandas().reset_index(drop=True))
+            else:
+                parts.append(pd.read_csv(f))
+        df = DataFrame.from_partitions(parts or [pd.DataFrame()], session=self._session)
+        if self._schema is not None and parts:
+            from ..frame.dataframe import coerce_to_schema
+            df = DataFrame.from_partitions([coerce_to_schema(p, self._schema) for p in parts],
+                                           session=self._session, schema=self._schema)
+        return df
+
+
+class DataStreamWriter:
+    def __init__(self, sdf):
+        self._sdf = sdf
+        self._format = "memory"
+        self._output_mode = "append"
+        self._options: Dict[str, Any] = {}
+        self._query_name: Optional[str] = None
+        self._trigger_once = False
+        self._interval_s = 0.1
+
+    def format(self, f: str) -> "DataStreamWriter":  # noqa: A003
+        self._format = f.lower()
+        return self
+
+    def outputMode(self, m: str) -> "DataStreamWriter":
+        self._output_mode = m
+        return self
+
+    def option(self, key: str, value) -> "DataStreamWriter":
+        self._options[key] = value
+        return self
+
+    def queryName(self, name: str) -> "DataStreamWriter":
+        self._query_name = name
+        return self
+
+    def trigger(self, once: bool = False, processingTime: Optional[str] = None,
+                availableNow: bool = False) -> "DataStreamWriter":
+        self._trigger_once = once or availableNow
+        if processingTime:
+            num = float(processingTime.split()[0])
+            unit = processingTime.split()[1] if " " in processingTime else "seconds"
+            self._interval_s = num * (60 if unit.startswith("min") else 1)
+        return self
+
+    def start(self, path: Optional[str] = None) -> "StreamingQuery":
+        if path is not None:
+            self._options.setdefault("path", path)
+        q = StreamingQuery(self._sdf, self._format, self._output_mode, self._options,
+                           self._query_name, self._trigger_once, self._interval_s)
+        with _lock:
+            _active_queries.append(q)
+        q._start()
+        return q
+
+    def toTable(self, name: str) -> "StreamingQuery":
+        self._options["table"] = name
+        return self.start()
+
+
+class StreamingQuery:
+    _next_id = 0
+
+    def __init__(self, sdf, fmt: str, output_mode: str, options: Dict[str, Any],
+                 name: Optional[str], once: bool, interval_s: float):
+        StreamingQuery._next_id += 1
+        self.id = f"query-{StreamingQuery._next_id}"
+        self.name = name or self.id
+        self._sdf = sdf
+        self._fmt = fmt
+        self._options = options
+        self._once = once
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.recentProgress: List[Dict[str, Any]] = []
+        self._mem_parts: List[pd.DataFrame] = []
+        self._ckpt = options.get("checkpointLocation")
+        self._processed = self._load_checkpoint()
+        self._exception: Optional[BaseException] = None
+
+    # -- checkpoint (recovery contract of MLE 00:75-85) --
+    def _load_checkpoint(self) -> set:
+        if self._ckpt and os.path.exists(os.path.join(self._ckpt, "processed.json")):
+            with open(os.path.join(self._ckpt, "processed.json")) as fh:
+                return set(json.load(fh))
+        return set()
+
+    def _save_checkpoint(self) -> None:
+        if not self._ckpt:
+            return
+        os.makedirs(self._ckpt, exist_ok=True)
+        tmp = os.path.join(self._ckpt, "processed.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(sorted(self._processed), fh)
+        os.replace(tmp, os.path.join(self._ckpt, "processed.json"))
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                did = self._process_one_trigger()
+                if self._once and not did:
+                    break
+                if not did:
+                    time.sleep(self._interval_s)
+        except BaseException as e:  # surfaced via .exception()
+            self._exception = e
+        finally:
+            self._stop.set()
+
+    def _process_one_trigger(self) -> bool:
+        files = [f for f in self._sdf._list_files() if f not in self._processed]
+        if not files:
+            return False
+        per_trigger = int(self._sdf._options.get("maxFilesPerTrigger", len(files)))
+        batch_files = files[:max(1, per_trigger)]
+        df = self._sdf._read_files(batch_files)
+        for op in self._sdf._ops:
+            df = op(df)
+        self._write_batch(df)
+        self._processed.update(batch_files)
+        self._save_checkpoint()
+        self.recentProgress.append({
+            "id": self.id, "name": self.name, "numInputRows": df.count(),
+            "files": batch_files, "timestamp": time.time(),
+        })
+        return True
+
+    def _write_batch(self, df: DataFrame) -> None:
+        if self._fmt == "memory":
+            self._mem_parts.append(df.toPandas())
+            session = self._sdf._session
+            full = pd.concat(self._mem_parts, ignore_index=True)
+            session.catalog._register_view(
+                self.name, DataFrame.from_pandas(full, session=session))
+        elif self._fmt in ("parquet", "csv", "json"):
+            df.write.format(self._fmt).mode("append").save(self._options["path"])
+        elif self._fmt == "delta":
+            df.write.format("delta").mode("append").save(self._options["path"])
+        elif self._fmt == "noop":
+            df.count()
+        else:
+            raise ValueError(f"unknown sink format {self._fmt}")
+
+    # -- public control surface --
+    @property
+    def isActive(self) -> bool:
+        return not self._stop.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def awaitTermination(self, timeout: Optional[float] = None) -> bool:
+        self._stop.wait(timeout)
+        return self._stop.is_set()
+
+    def processAllAvailable(self) -> None:
+        while any(f not in self._processed for f in self._sdf._list_files()):
+            if not self.isActive:
+                if self._exception is not None:
+                    raise RuntimeError("streaming query terminated with error") from self._exception
+                return
+            time.sleep(0.05)
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exception
+
+    @property
+    def lastProgress(self) -> Optional[Dict[str, Any]]:
+        return self.recentProgress[-1] if self.recentProgress else None

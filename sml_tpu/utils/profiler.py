@@ -1,0 +1,87 @@
+"""Op-level structured timing + XLA profiler hooks (SURVEY §5 "Tracing").
+
+The reference leans on the Spark UI / Ganglia for shuffle, storage and
+executor metrics (`SML/ML 00b - Spark Review.py:78-84`,
+`SML/ML Electives/MLE 05 - Best Practices.py:31-36`). The replacement is a
+structured in-process trace: every engine op records name, wall time, rows,
+and bytes; `report()` renders the UI-equivalent table and
+`start_device_trace` wires `jax.profiler` for XLA-level traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+from ..conf import GLOBAL_CONF
+
+
+@dataclass
+class Span:
+    name: str
+    wall_s: float
+    rows: Optional[int] = None
+    meta: Dict[str, object] = field(default_factory=dict)
+
+
+class Profiler:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._spans: List[Span] = []
+
+    @property
+    def enabled(self) -> bool:
+        return GLOBAL_CONF.getBool("sml.profiler.enabled")
+
+    @contextlib.contextmanager
+    def span(self, name: str, rows: Optional[int] = None, **meta) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._spans.append(Span(name, dt, rows, meta))
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def report(self) -> str:
+        """Spark-UI-style aggregate table: op, calls, total s, rows."""
+        agg: Dict[str, List[float]] = {}
+        rows_agg: Dict[str, int] = {}
+        for s in self.spans():
+            agg.setdefault(s.name, []).append(s.wall_s)
+            if s.rows:
+                rows_agg[s.name] = rows_agg.get(s.name, 0) + s.rows
+        lines = [f"{'op':<32}{'calls':>8}{'total_s':>12}{'rows':>14}"]
+        for name in sorted(agg, key=lambda n: -sum(agg[n])):
+            ts = agg[name]
+            lines.append(f"{name:<32}{len(ts):>8}{sum(ts):>12.4f}{rows_agg.get(name, 0):>14}")
+        return "\n".join(lines)
+
+
+PROFILER = Profiler()
+
+
+@contextlib.contextmanager
+def start_device_trace(logdir: str) -> Iterator[None]:
+    """XLA-level trace (TensorBoard-compatible) around a block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,9 @@
+"""Version metadata for sml_tpu.
+
+Mirrors the reference courseware's version surface
+(`SML/Version Info.py:10-14` — course 3.7.3, build date) with our own
+framework version.
+"""
+
+__version__ = "0.1.0"
+COURSE_COMPAT = "3.7.3"  # reference course version whose API surface we cover

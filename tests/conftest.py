@@ -1,0 +1,60 @@
+"""Test harness: run on a virtual 8-device CPU mesh.
+
+Real multi-chip hardware is not available in CI; the sharding/collective
+paths are validated on a host-local 8-device mesh the same way the course
+relies on seeded determinism instead of a cluster (SURVEY §4).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The env-var route (JAX_PLATFORMS=cpu) is overridden by the axon TPU plugin
+# in this image; the config API wins.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def spark():
+    from sml_tpu import TpuSession
+    return TpuSession.builder.appName("tests").getOrCreate()
+
+
+@pytest.fixture()
+def airbnb_pdf():
+    """Synthetic SF-Airbnb-like dataset (the real one is blob-hosted and not
+    redistributable in-tree); schema mirrors the course's cleaned table."""
+    rng = np.random.default_rng(7)
+    n = 2000
+    neighbourhoods = ["Mission", "SoMa", "Sunset", "Richmond", "Castro", "Noe Valley"]
+    room_types = ["Entire home/apt", "Private room", "Shared room"]
+    bedrooms = rng.integers(0, 5, n).astype(float)
+    accommodates = (bedrooms * 2 + rng.integers(1, 3, n)).astype(float)
+    price = np.round(
+        np.exp(4.0 + 0.35 * bedrooms + 0.08 * accommodates + rng.normal(0, 0.4, n)), 2)
+    pdf = pd.DataFrame({
+        "id": np.arange(n, dtype=np.int64),
+        "neighbourhood_cleansed": rng.choice(neighbourhoods, n),
+        "room_type": rng.choice(room_types, n, p=[0.6, 0.3, 0.1]),
+        "bedrooms": bedrooms,
+        "bathrooms": rng.choice([1.0, 1.5, 2.0, 2.5], n),
+        "accommodates": accommodates,
+        "number_of_reviews": rng.integers(0, 300, n).astype(float),
+        "review_scores_rating": np.clip(rng.normal(93, 6, n), 20, 100),
+        "minimum_nights": rng.integers(1, 30, n).astype(float),
+        "price": price,
+    })
+    return pdf
+
+
+@pytest.fixture()
+def airbnb_df(spark, airbnb_pdf):
+    return spark.createDataFrame(airbnb_pdf)
