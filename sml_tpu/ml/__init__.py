@@ -1,0 +1,8 @@
+"""MLlib-compatible pipeline API whose distributed math is jitted XLA over
+the device mesh (SURVEY §1 L3; build plan §7 stages 4-6)."""
+
+from .base import Estimator, Model, Pipeline, PipelineModel, Transformer, load_native
+from .param import Param, Params
+
+__all__ = ["Estimator", "Model", "Pipeline", "PipelineModel", "Transformer",
+           "Param", "Params", "load_native"]

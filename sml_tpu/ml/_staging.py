@@ -1,0 +1,100 @@
+"""Host → HBM staging for estimator math.
+
+Every distributed fit in this package has the same shape (SURVEY §3.1's TPU
+mapping): pull the assembled feature column + label out of the host frame,
+densify to (n, d) float arrays, zero-pad rows to a per-chip-equal block,
+`jax.device_put` sharded over the mesh's data axis, and run a jitted
+`shard_map` program whose cross-chip reductions are `psum` over ICI — the
+replacement for Spark's executor→driver `treeAggregate`
+(`SML/Labs/ML 02L - Linear Regression I Lab.py:70-77`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel import mesh as meshlib
+from .linalg import Vector, to_matrix
+
+
+def extract_features(df, featuresCol: str) -> np.ndarray:
+    """(n, d) float32 matrix from a vector/array column of a host frame."""
+    pdf = df.toPandas() if hasattr(df, "toPandas") else df
+    col = pdf[featuresCol]
+    vals = col.tolist()
+    if vals and isinstance(vals[0], (Vector, list, tuple, np.ndarray)):
+        X = to_matrix(vals)
+    else:  # single numeric column used as a 1-feature matrix
+        X = np.asarray(col, dtype=np.float64)[:, None]
+    return np.ascontiguousarray(X, dtype=np.float32)
+
+
+def extract_xy(df, featuresCol: str, labelCol: str,
+               weightCol: Optional[str] = None) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    pdf = df.toPandas() if hasattr(df, "toPandas") else df
+    X = extract_features(pdf, featuresCol)
+    y = np.asarray(pdf[labelCol], dtype=np.float32)
+    w = np.asarray(pdf[weightCol], dtype=np.float32) if weightCol else None
+    return X, y, w
+
+
+def stage_sharded(*arrays: np.ndarray):
+    """Pad + shard host arrays by rows over the data axis.
+
+    Returns (device_arrays..., mask_device, n_true). The mask is 1.0 for real
+    rows, 0.0 for padding; all statistics must be mask-weighted so padding is
+    inert under psum.
+    """
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_true = arrays[0].shape[0]
+    outs = []
+    for a in arrays:
+        padded, _ = meshlib.pad_rows(np.asarray(a), n_dev)
+        outs.append(jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim)))
+    n_padded = outs[0].shape[0]
+    mask = meshlib.row_mask(n_padded, n_true)
+    mask_dev = jax.device_put(mask, meshlib.data_sharding(mesh, 1))
+    return (*outs, mask_dev, n_true)
+
+
+def data_parallel(fn: Callable, *, out_replicated: bool = True) -> Callable:
+    """jit(shard_map(fn)) over the active mesh's data axis.
+
+    `fn` sees per-chip row blocks and may call `parallel.collectives.psum`
+    etc. on the "data" axis; outputs are replicated (each chip returns the
+    same reduced value) unless out_replicated=False (then row-sharded).
+    """
+    mesh = meshlib.get_mesh()
+    in_spec = P(meshlib.DATA_AXIS)
+    out_spec = P() if out_replicated else P(meshlib.DATA_AXIS)
+
+    def spec_for(x):
+        return P(*([meshlib.DATA_AXIS] + [None] * (np.ndim(x) - 1)))
+
+    def wrapped(*args):
+        specs = tuple(spec_for(a) for a in args)
+        mapped = shard_map(fn, mesh=mesh, in_specs=specs,
+                           out_specs=out_spec, check_vma=False)
+        return mapped(*args)
+
+    return jax.jit(wrapped)
+
+
+def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True):
+    """One-shot: stage arrays sharded, run fn(blocks..., mask) under
+    jit+shard_map, return host numpy results."""
+    staged = stage_sharded(*arrays)
+    dev_args, mask, _ = staged[:-2], staged[-2], staged[-1]
+    compiled = data_parallel(fn, out_replicated=out_replicated)
+    out = compiled(*dev_args, mask)
+    return jax.tree_util.tree_map(np.asarray, out)

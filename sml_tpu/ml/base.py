@@ -1,0 +1,269 @@
+"""Transformer / Estimator / Model / Pipeline and on-disk persistence.
+
+Contract (stated in the reference at `SML/ML 01 - Data Cleansing.py:242-247`):
+a Transformer's `.transform(df)` appends columns; an Estimator's `.fit(df)`
+learns and returns a Model, which is itself a Transformer. `Pipeline` chains
+stages (`SML/ML 03 - Linear Regression II.py:100-129`), and pipeline models
+persist via `.write().overwrite().save(path)` / `PipelineModel.load(path)`.
+
+Persistence format (ours, not Spark's): a directory with `metadata.json`
+({class, uid, params, extra}) plus optional `data.npz` for array state;
+pipelines hold `stages/NN_uid/` subdirectories. Classes self-describe their
+array state through `_save_state()/_load_state()`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .param import Params
+
+
+class MLWriter:
+    def __init__(self, instance: "Saveable"):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "MLWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        if os.path.exists(path):
+            if not self._overwrite:
+                raise IOError(f"Path {path} already exists; use .overwrite()")
+            shutil.rmtree(path)
+        self._instance._save_to(path)
+
+
+class Saveable:
+    """Mixin providing write()/save()/load() over the directory format."""
+
+    def write(self) -> MLWriter:
+        return MLWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    # -- subclass hooks ---------------------------------------------------
+    def _extra_metadata(self) -> Dict[str, Any]:
+        return {}
+
+    def _save_state(self, path: str) -> None:
+        """Save non-param array/object state; default: nothing."""
+
+    def _load_state(self, path: str, meta: Dict[str, Any]) -> None:
+        """Restore non-param state; default: nothing."""
+
+    # -- machinery --------------------------------------------------------
+    def _save_to(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "class": f"{type(self).__module__}.{type(self).__name__}",
+            "uid": getattr(self, "uid", None),
+            "params": self._params_to_dict() if isinstance(self, Params) else {},
+            "extra": self._extra_metadata(),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        self._save_state(path)
+
+    @classmethod
+    def load(cls, path: str) -> Any:
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        module, _, name = meta["class"].rpartition(".")
+        klass = getattr(importlib.import_module(module), name)
+        obj = klass.__new__(klass)
+        Params.__init__(obj)
+        if meta.get("uid"):
+            obj.uid = meta["uid"]
+        obj._init_params()
+        obj._params_from_dict(meta.get("params", {}))
+        obj._load_state(path, meta.get("extra", {}))
+        return obj
+
+    def _init_params(self) -> None:
+        """Subclasses declare their Params here (called by both __init__ and
+        load); default: nothing."""
+
+    @staticmethod
+    def read():
+        raise NotImplementedError("use .load(path)")
+
+
+def save_arrays(path: str, **arrays) -> None:
+    np.savez(os.path.join(path, "data.npz"), **arrays)
+
+
+def load_arrays(path: str) -> Dict[str, np.ndarray]:
+    fp = os.path.join(path, "data.npz")
+    if not os.path.exists(fp):
+        return {}
+    with np.load(fp, allow_pickle=True) as z:
+        return {k: z[k] for k in z.files}
+
+
+class Transformer(Params, Saveable):
+    def __init__(self):
+        Params.__init__(self)
+        self._init_params()
+
+    def transform(self, df, params: Optional[dict] = None):
+        if params:
+            return self.copy(params).transform(df)
+        return self._transform(df)
+
+    def _transform(self, df):
+        raise NotImplementedError
+
+
+class Estimator(Params, Saveable):
+    def __init__(self):
+        Params.__init__(self)
+        self._init_params()
+
+    def fit(self, df, params: Optional[dict] = None):
+        if params:
+            return self.copy(params).fit(df)
+        return self._fit(df)
+
+    def _fit(self, df):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer (MLlib: Model[M] extends Transformer)."""
+
+    def _inherit_params(self, est: Params) -> "Model":
+        """Copy the estimator's set params onto this model (shared names)."""
+        for p, v in est._paramMap.items():
+            if self.hasParam(p.name):
+                self._paramMap[self.getParam(p.name)] = v
+        return self
+
+
+class Evaluator(Params, Saveable):
+    def __init__(self):
+        Params.__init__(self)
+        self._init_params()
+
+    def evaluate(self, df, params: Optional[dict] = None) -> float:
+        if params:
+            return self.copy(params).evaluate(df)
+        return self._evaluate(df)
+
+    def _evaluate(self, df) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator):
+    """`Pipeline(stages=[...])` — sequentially fit estimators / apply
+    transformers (`ML 03:100-113`)."""
+
+    def _init_params(self):
+        self._declareParam("stages", default=[], doc="pipeline stages")
+
+    def __init__(self, stages: Optional[List] = None):
+        super().__init__()
+        if stages is not None:
+            self._set(stages=stages)
+
+    def getStages(self) -> List:
+        return self.getOrDefault("stages")
+
+    def setStages(self, stages: List) -> "Pipeline":
+        return self._set(stages=stages)
+
+    def _fit(self, df) -> "PipelineModel":
+        stages = self.getStages()
+        fitted: List[Transformer] = []
+        cur = df
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+            else:
+                raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+        return PipelineModel(fitted)
+
+    def copy(self, extra=None) -> "Pipeline":
+        that = super().copy(extra)
+        # stages hold estimators with their own params: apply any extra params
+        # addressed to them (tuning passes {est.param: v} through the pipeline)
+        if extra:
+            new_stages = []
+            for s in that.getStages():
+                applicable = {p: v for p, v in extra.items()
+                              if getattr(p, "parent", None) == s.uid}
+                new_stages.append(s.copy(applicable) if applicable else s)
+            that._paramMap[that.getParam("stages")] = new_stages
+        return that
+
+    # -- persistence ------------------------------------------------------
+    def _extra_metadata(self):
+        return {"n_stages": len(self.getStages())}
+
+    def _save_state(self, path: str) -> None:
+        for i, s in enumerate(self.getStages()):
+            s._save_to(os.path.join(path, "stages", f"{i:02d}_{s.uid}"))
+
+    def _load_state(self, path: str, meta) -> None:
+        stage_dir = os.path.join(path, "stages")
+        stages = []
+        for d in sorted(os.listdir(stage_dir)) if os.path.exists(stage_dir) else []:
+            stages.append(Saveable.load(os.path.join(stage_dir, d)))
+        self._paramMap[self.getParam("stages")] = stages
+
+
+class PipelineModel(Model):
+    def _init_params(self):
+        pass
+
+    def __init__(self, stages: Optional[List[Transformer]] = None):
+        super().__init__()
+        self.stages: List[Transformer] = stages or []
+
+    def _transform(self, df):
+        cur = df
+        for s in self.stages:
+            cur = s.transform(cur)
+        return cur
+
+    def copy(self, extra=None) -> "PipelineModel":
+        that = super().copy(extra)
+        that.stages = [s.copy(extra) for s in self.stages]
+        return that
+
+    def _extra_metadata(self):
+        return {"n_stages": len(self.stages)}
+
+    def _save_state(self, path: str) -> None:
+        for i, s in enumerate(self.stages):
+            s._save_to(os.path.join(path, "stages", f"{i:02d}_{s.uid}"))
+
+    def _load_state(self, path: str, meta) -> None:
+        stage_dir = os.path.join(path, "stages")
+        self.stages = []
+        for d in sorted(os.listdir(stage_dir)) if os.path.exists(stage_dir) else []:
+            self.stages.append(Saveable.load(os.path.join(stage_dir, d)))
+
+
+def load_native(path: str):
+    """Load any persisted sml_tpu ML object (generic entry point)."""
+    return Saveable.load(path)

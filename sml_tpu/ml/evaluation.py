@@ -1,0 +1,226 @@
+"""Evaluators: rmse/r2/mae, AUROC/AUPR, accuracy/f1.
+
+Reference surface: `RegressionEvaluator` (`SML/ML 02 - Linear Regression
+I.py:146-151`), `BinaryClassificationEvaluator` (`SML/Labs/ML 07L -
+Hyperparameter Tuning Lab.py:104-110`), `MulticlassClassificationEvaluator`
+(`SML/ML Electives/MLE 03 - Logistic Regression Lab.py:64-67`).
+
+The metric reductions are single-pass sums over row shards — the jitted
+psum pattern of `_staging.run_data_parallel`; ranking metrics (AUROC/AUPR)
+sort on host (n log n on scalars) then reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import collectives as coll
+from .base import Evaluator
+from ._staging import run_data_parallel
+
+
+def _pred_label(df, predictionCol: str, labelCol: str):
+    pdf = df.toPandas() if hasattr(df, "toPandas") else df
+    pred = np.asarray(pdf[predictionCol], dtype=np.float64)
+    lab = np.asarray(pdf[labelCol], dtype=np.float64)
+    ok = np.isfinite(pred) & np.isfinite(lab)
+    return pred[ok], lab[ok]
+
+
+class RegressionEvaluator(Evaluator):
+    def _init_params(self):
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("metricName", default="rmse", doc="rmse|mse|mae|r2|var")
+
+    def __init__(self, predictionCol=None, labelCol=None, metricName=None):
+        super().__init__()
+        self._set(predictionCol=predictionCol, labelCol=labelCol, metricName=metricName)
+
+    def setMetricName(self, v):
+        return self._set(metricName=v)
+
+    def getMetricName(self):
+        return self.getOrDefault("metricName")
+
+    def isLargerBetter(self) -> bool:
+        return self.getOrDefault("metricName") in ("r2", "var")
+
+    def _evaluate(self, df) -> float:
+        pred, lab = _pred_label(df, self.getOrDefault("predictionCol"),
+                                self.getOrDefault("labelCol"))
+        metric = self.getOrDefault("metricName")
+
+        def stats(p, l, mask):
+            # five sufficient statistics, one psum each — a single fused pass
+            n = coll.psum(jnp.sum(mask))
+            se = coll.psum(jnp.sum(mask * (p - l) ** 2))
+            ae = coll.psum(jnp.sum(mask * jnp.abs(p - l)))
+            sl = coll.psum(jnp.sum(mask * l))
+            sl2 = coll.psum(jnp.sum(mask * l * l))
+            return n, se, ae, sl, sl2
+
+        n, se, ae, sl, sl2 = run_data_parallel(
+            stats, pred.astype(np.float32), lab.astype(np.float32))
+        n = float(n)
+        if n == 0:
+            return float("nan")
+        mse = float(se) / n
+        if metric == "rmse":
+            return float(np.sqrt(mse))
+        if metric == "mse":
+            return mse
+        if metric == "mae":
+            return float(ae) / n
+        if metric in ("r2", "var"):
+            var = float(sl2) / n - (float(sl) / n) ** 2
+            if metric == "var":
+                return var
+            return 1.0 - mse / var if var > 0 else 0.0
+        raise ValueError(f"unknown metricName {metric!r}")
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    def _init_params(self):
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="score column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("metricName", default="areaUnderROC",
+                           doc="areaUnderROC|areaUnderPR")
+
+    def __init__(self, rawPredictionCol=None, labelCol=None, metricName=None):
+        super().__init__()
+        self._set(rawPredictionCol=rawPredictionCol, labelCol=labelCol,
+                  metricName=metricName)
+
+    def setMetricName(self, v):
+        return self._set(metricName=v)
+
+    def _scores(self, df):
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        col = self.getOrDefault("rawPredictionCol")
+        if col not in pdf.columns:
+            for alt in ("probability", "prediction"):
+                if alt in pdf.columns:
+                    col = alt
+                    break
+        vals = pdf[col]
+        if len(vals) and hasattr(vals.iloc[0], "toArray"):
+            score = np.asarray([v.toArray()[-1] for v in vals], dtype=np.float64)
+        elif len(vals) and isinstance(vals.iloc[0], (list, tuple, np.ndarray)):
+            score = np.asarray([v[-1] for v in vals], dtype=np.float64)
+        else:
+            score = np.asarray(vals, dtype=np.float64)
+        lab = np.asarray(pdf[self.getOrDefault("labelCol")], dtype=np.float64)
+        ok = np.isfinite(score) & np.isfinite(lab)
+        return score[ok], lab[ok]
+
+    def _evaluate(self, df) -> float:
+        score, lab = self._scores(df)
+        metric = self.getOrDefault("metricName")
+        order = np.argsort(-score, kind="mergesort")
+        lab = lab[order]
+        score = score[order]
+        tp = np.cumsum(lab)
+        fp = np.cumsum(1 - lab)
+        # collapse ties: keep last index of each distinct score
+        distinct = np.nonzero(np.diff(score))[0]
+        idx = np.concatenate([distinct, [len(score) - 1]])
+        tp, fp = tp[idx], fp[idx]
+        P, N = tp[-1], fp[-1]
+        if P == 0 or (metric == "areaUnderROC" and N == 0):
+            return float("nan")
+        if metric == "areaUnderROC":
+            tpr = np.concatenate([[0.0], tp / P])
+            fpr = np.concatenate([[0.0], fp / N])
+            return float(np.trapezoid(tpr, fpr))
+        # areaUnderPR
+        precision = tp / (tp + fp)
+        recall = tp / P
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[precision[0]], precision])
+        return float(np.trapezoid(precision, recall))
+
+
+class MulticlassClassificationEvaluator(Evaluator):
+    def _init_params(self):
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("metricName", default="f1", doc="f1|accuracy|weightedPrecision|weightedRecall")
+
+    def __init__(self, predictionCol=None, labelCol=None, metricName=None):
+        super().__init__()
+        self._set(predictionCol=predictionCol, labelCol=labelCol, metricName=metricName)
+
+    def setMetricName(self, v):
+        return self._set(metricName=v)
+
+    def _evaluate(self, df) -> float:
+        pred, lab = _pred_label(df, self.getOrDefault("predictionCol"),
+                                self.getOrDefault("labelCol"))
+        metric = self.getOrDefault("metricName")
+        if metric == "accuracy":
+            def acc(p, l, mask):
+                n = coll.psum(jnp.sum(mask))
+                c = coll.psum(jnp.sum(mask * (p == l)))
+                return c, n
+            c, n = run_data_parallel(acc, pred.astype(np.float32), lab.astype(np.float32))
+            return float(c) / float(n) if n else float("nan")
+        classes = np.unique(np.concatenate([pred, lab]))
+        stats = []
+        for k in classes:
+            tp = np.sum((pred == k) & (lab == k))
+            fp = np.sum((pred == k) & (lab != k))
+            fn = np.sum((pred != k) & (lab == k))
+            support = np.sum(lab == k)
+            prec = tp / (tp + fp) if tp + fp else 0.0
+            rec = tp / (tp + fn) if tp + fn else 0.0
+            f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+            stats.append((support, prec, rec, f1))
+        support = np.array([s[0] for s in stats], dtype=np.float64)
+        w = support / support.sum()
+        if metric == "weightedPrecision":
+            return float(np.sum(w * [s[1] for s in stats]))
+        if metric == "weightedRecall":
+            return float(np.sum(w * [s[2] for s in stats]))
+        if metric == "f1":
+            return float(np.sum(w * [s[3] for s in stats]))
+        raise ValueError(f"unknown metricName {metric!r}")
+
+
+class ClusteringEvaluator(Evaluator):
+    """Silhouette (squared euclidean) — the MLlib default."""
+
+    def _init_params(self):
+        self._declareParam("predictionCol", default="prediction", doc="cluster column")
+        self._declareParam("featuresCol", default="features", doc="features column")
+        self._declareParam("metricName", default="silhouette", doc="silhouette")
+
+    def __init__(self, predictionCol=None, featuresCol=None, metricName=None):
+        super().__init__()
+        self._set(predictionCol=predictionCol, featuresCol=featuresCol,
+                  metricName=metricName)
+
+    def _evaluate(self, df) -> float:
+        from ._staging import extract_features
+        pdf = df.toPandas()
+        X = extract_features(pdf, self.getOrDefault("featuresCol"))
+        labels = np.asarray(pdf[self.getOrDefault("predictionCol")], dtype=int)
+        ks = np.unique(labels)
+        if len(ks) < 2:
+            return float("nan")
+        # simplified silhouette via cluster means (squared distances), the
+        # same O(n·k) formulation MLlib uses
+        centers = np.stack([X[labels == k].mean(axis=0) for k in ks])
+        counts = np.array([(labels == k).sum() for k in ks], dtype=np.float64)
+        d2 = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        own = np.array([np.nonzero(ks == l)[0][0] for l in labels])
+        a = d2[np.arange(len(X)), own]
+        d2_other = d2.copy()
+        d2_other[np.arange(len(X)), own] = np.inf
+        b = d2_other.min(axis=1)
+        s = (b - a) / np.maximum(a, b)
+        s[counts[own] == 1] = 0.0
+        return float(np.mean(s))

@@ -1,0 +1,557 @@
+"""Feature transformers (the L3 surface exercised by the courseware).
+
+Host-side metadata/string ops (vocab builds, category maps) stay on the host
+frame — SURVEY §7 "Hard parts" #4: strings do not belong on the MXU — while
+their numeric output columns are what the estimators stage into HBM.
+
+Coverage and reference behavior:
+- `Imputer(strategy="median")`                `SML/ML 01 - Data Cleansing.py:251-256`
+- `VectorAssembler`                           `SML/ML 02 - Linear Regression I.py:103-107`
+- `StringIndexer(handleInvalid="skip")`       `SML/ML 03 - Linear Regression II.py:54-61`
+- `OneHotEncoder`                             `SML/ML 03 - Linear Regression II.py:54-61`
+- `RFormula("price ~ .")`                     `SML/ML 04 - MLflow Tracking.py:110-117`
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from .base import Estimator, Model, Transformer
+from .linalg import DenseVector, SparseVector, Vector
+
+
+def _as_object_series(values: List) -> pd.Series:
+    s = pd.Series([None] * len(values), dtype=object)
+    for i, v in enumerate(values):
+        s.iloc[i] = v
+    return s
+
+
+# --------------------------------------------------------------------------
+class VectorAssembler(Transformer):
+    """Concatenate numeric / vector columns into one feature vector column."""
+
+    def _init_params(self):
+        self._declareParam("inputCols", doc="input column names")
+        self._declareParam("outputCol", default="features", doc="output column")
+        self._declareParam("handleInvalid", default="error", doc="error|skip|keep")
+
+    def __init__(self, inputCols: Optional[List[str]] = None,
+                 outputCol: Optional[str] = None, handleInvalid: Optional[str] = None):
+        super().__init__()
+        self._set(inputCols=inputCols, outputCol=outputCol, handleInvalid=handleInvalid)
+
+    def getInputCols(self):
+        return self.getOrDefault("inputCols")
+
+    def getOutputCol(self):
+        return self.getOrDefault("outputCol")
+
+    def setInputCols(self, v):
+        return self._set(inputCols=v)
+
+    def setOutputCol(self, v):
+        return self._set(outputCol=v)
+
+    def _transform(self, df):
+        in_cols = list(self.getOrDefault("inputCols"))
+        out_col = self.getOrDefault("outputCol")
+        invalid = self.getOrDefault("handleInvalid")
+
+        def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
+            if len(pdf) == 0:
+                out = pdf.copy()
+                out[out_col] = _as_object_series([])
+                return out
+            blocks = []
+            for c in in_cols:
+                col = pdf[c]
+                if len(col) and isinstance(col.iloc[0], Vector):
+                    blocks.append(np.stack([v.toArray() for v in col]))
+                else:
+                    blocks.append(np.asarray(pd.to_numeric(col, errors="coerce"),
+                                             dtype=np.float64)[:, None])
+            mat = np.concatenate(blocks, axis=1)
+            bad = ~np.isfinite(mat).all(axis=1)
+            out = pdf.copy()
+            if bad.any():
+                if invalid == "error":
+                    raise ValueError(
+                        f"VectorAssembler found NaN/null in {in_cols}; set "
+                        f"handleInvalid='skip' or impute first")
+                if invalid == "skip":
+                    out = out[~bad].reset_index(drop=True)
+                    mat = mat[~bad]
+            out[out_col] = _as_object_series([DenseVector(r) for r in mat])
+            return out
+
+        return df._derive(fn)
+
+
+# --------------------------------------------------------------------------
+class StringIndexer(Estimator):
+    """Map string categories → double indices ordered by descending frequency
+    (ties broken lexically), matching MLlib's default `frequencyDesc`."""
+
+    def _init_params(self):
+        self._declareParam("inputCol", doc="input column")
+        self._declareParam("outputCol", doc="output column")
+        self._declareParam("inputCols", doc="input columns (multi)")
+        self._declareParam("outputCols", doc="output columns (multi)")
+        self._declareParam("handleInvalid", default="error", doc="error|skip|keep")
+        self._declareParam("stringOrderType", default="frequencyDesc",
+                           doc="frequencyDesc|frequencyAsc|alphabetDesc|alphabetAsc")
+
+    def __init__(self, inputCol=None, outputCol=None, inputCols=None,
+                 outputCols=None, handleInvalid=None, stringOrderType=None):
+        super().__init__()
+        self._set(inputCol=inputCol, outputCol=outputCol, inputCols=inputCols,
+                  outputCols=outputCols, handleInvalid=handleInvalid,
+                  stringOrderType=stringOrderType)
+
+    def _in_out(self):
+        multi_in = self.getOrDefault("inputCols")
+        if multi_in:
+            return list(multi_in), list(self.getOrDefault("outputCols"))
+        return [self.getOrDefault("inputCol")], [self.getOrDefault("outputCol")]
+
+    def _fit(self, df) -> "StringIndexerModel":
+        in_cols, out_cols = self._in_out()
+        order = self.getOrDefault("stringOrderType")
+        pdf = df.toPandas()
+        labels: List[List[str]] = []
+        for c in in_cols:
+            s = pdf[c].dropna().astype(str)
+            if order.startswith("frequency"):
+                counts = s.value_counts()
+                # stable order: count desc then label asc (MLlib tie-break)
+                items = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+                lab = [k for k, _ in items]
+                if order == "frequencyAsc":
+                    lab = lab[::-1]
+            else:
+                lab = sorted(s.unique())
+                if order == "alphabetDesc":
+                    lab = lab[::-1]
+            labels.append(lab)
+        m = StringIndexerModel(labels=labels)
+        m._inherit_params(self)
+        return m
+
+
+class StringIndexerModel(Model):
+    def _init_params(self):
+        StringIndexer._init_params(self)
+
+    def __init__(self, labels: Optional[List[List[str]]] = None):
+        super().__init__()
+        self.labelsArray: List[List[str]] = labels or []
+
+    @property
+    def labels(self) -> List[str]:
+        return self.labelsArray[0] if self.labelsArray else []
+
+    def _transform(self, df):
+        in_cols, out_cols = StringIndexer._in_out(self)
+        invalid = self.getOrDefault("handleInvalid")
+        maps = [{lab: float(i) for i, lab in enumerate(ls)} for ls in self.labelsArray]
+
+        def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
+            out = pdf.copy()
+            keep_mask = np.ones(len(pdf), dtype=bool)
+            for c, oc, mapping in zip(in_cols, out_cols, maps):
+                vals = out[c].map(lambda v: None if v is None or
+                                  (isinstance(v, float) and np.isnan(v)) else str(v))
+                idx = vals.map(lambda v: mapping.get(v) if v is not None else None)
+                missing = idx.isna().values
+                if missing.any():
+                    if invalid == "error":
+                        bad = vals[missing].iloc[0]
+                        raise ValueError(f"Unseen label {bad!r} in column {c!r} "
+                                         f"(handleInvalid='error')")
+                    if invalid == "skip":
+                        keep_mask &= ~missing
+                    else:  # keep → extra index = numLabels
+                        idx = idx.where(~pd.Series(missing), float(len(mapping)))
+                out[oc] = idx.astype(float)
+            if not keep_mask.all():
+                out = out[keep_mask].reset_index(drop=True)
+            return out
+
+        return df._derive(fn)
+
+    def _extra_metadata(self):
+        return {"labelsArray": self.labelsArray}
+
+    def _load_state(self, path, meta):
+        self.labelsArray = [list(x) for x in meta.get("labelsArray", [])]
+
+
+class IndexToString(Transformer):
+    def _init_params(self):
+        self._declareParam("inputCol", doc="index column")
+        self._declareParam("outputCol", doc="label column")
+        self._declareParam("labels", doc="labels list")
+
+    def __init__(self, inputCol=None, outputCol=None, labels=None):
+        super().__init__()
+        self._set(inputCol=inputCol, outputCol=outputCol, labels=labels)
+
+    def _transform(self, df):
+        labels = list(self.getOrDefault("labels"))
+        ic, oc = self.getOrDefault("inputCol"), self.getOrDefault("outputCol")
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            out[oc] = out[ic].map(lambda i: labels[int(i)] if pd.notna(i) and
+                                  int(i) < len(labels) else None)
+            return out
+
+        return df._derive(fn)
+
+
+# --------------------------------------------------------------------------
+class OneHotEncoder(Estimator):
+    """Index column(s) → sparse one-hot vectors, `dropLast=True` like MLlib."""
+
+    def _init_params(self):
+        self._declareParam("inputCols", doc="input index columns")
+        self._declareParam("outputCols", doc="output vector columns")
+        self._declareParam("inputCol", doc="input index column")
+        self._declareParam("outputCol", doc="output vector column")
+        self._declareParam("dropLast", default=True, doc="drop last category")
+        self._declareParam("handleInvalid", default="error", doc="error|keep")
+
+    def __init__(self, inputCols=None, outputCols=None, inputCol=None,
+                 outputCol=None, dropLast: Optional[bool] = None, handleInvalid=None):
+        super().__init__()
+        self._set(inputCols=inputCols, outputCols=outputCols, inputCol=inputCol,
+                  outputCol=outputCol, handleInvalid=handleInvalid)
+        if dropLast is not None:
+            self._set(dropLast=dropLast)
+
+    def _in_out(self):
+        multi = self.getOrDefault("inputCols")
+        if multi:
+            return list(multi), list(self.getOrDefault("outputCols"))
+        return [self.getOrDefault("inputCol")], [self.getOrDefault("outputCol")]
+
+    def _fit(self, df) -> "OneHotEncoderModel":
+        in_cols, _ = self._in_out()
+        pdf = df.toPandas()
+        sizes = [int(pd.to_numeric(pdf[c], errors="coerce").max()) + 1
+                 if len(pdf) else 0 for c in in_cols]
+        m = OneHotEncoderModel(categorySizes=sizes)
+        m._inherit_params(self)
+        return m
+
+
+class OneHotEncoderModel(Model):
+    def _init_params(self):
+        OneHotEncoder._init_params(self)
+
+    def __init__(self, categorySizes: Optional[List[int]] = None):
+        super().__init__()
+        self.categorySizes: List[int] = categorySizes or []
+
+    def _transform(self, df):
+        in_cols, out_cols = OneHotEncoder._in_out(self)
+        drop_last = bool(self.getOrDefault("dropLast"))
+        sizes = self.categorySizes
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            for c, oc, size in zip(in_cols, out_cols, sizes):
+                width = size - 1 if drop_last else size
+                vecs = []
+                for v in pd.to_numeric(out[c], errors="coerce"):
+                    if pd.isna(v):
+                        vecs.append(None)
+                        continue
+                    i = int(v)
+                    if i < width:
+                        vecs.append(SparseVector(width, [i], [1.0]))
+                    else:  # dropped last category (or overflow w/ keep)
+                        vecs.append(SparseVector(width, [], []))
+                out[oc] = _as_object_series(vecs)
+            return out
+
+        return df._derive(fn)
+
+    def _extra_metadata(self):
+        return {"categorySizes": self.categorySizes}
+
+    def _load_state(self, path, meta):
+        self.categorySizes = list(meta.get("categorySizes", []))
+
+
+# --------------------------------------------------------------------------
+class Imputer(Estimator):
+    """Fill numeric nulls with per-column median/mean/mode
+    (`ML 01:251-256` uses strategy="median")."""
+
+    def _init_params(self):
+        self._declareParam("inputCols", doc="columns to impute")
+        self._declareParam("outputCols", doc="imputed output columns")
+        self._declareParam("strategy", default="mean", doc="mean|median|mode")
+        self._declareParam("missingValue", default=float("nan"), doc="value treated as missing")
+
+    def __init__(self, strategy: Optional[str] = None, inputCols=None, outputCols=None,
+                 missingValue: Optional[float] = None):
+        super().__init__()
+        self._set(strategy=strategy, inputCols=inputCols, outputCols=outputCols,
+                  missingValue=missingValue)
+
+    def setStrategy(self, v):
+        return self._set(strategy=v)
+
+    def _fit(self, df) -> "ImputerModel":
+        in_cols = list(self.getOrDefault("inputCols"))
+        strategy = self.getOrDefault("strategy")
+        pdf = df.toPandas()
+        surrogates = {}
+        for c in in_cols:
+            s = pd.to_numeric(pdf[c], errors="coerce").dropna()
+            if strategy == "median":
+                surrogates[c] = float(s.median()) if len(s) else 0.0
+            elif strategy == "mode":
+                surrogates[c] = float(s.mode().iloc[0]) if len(s) else 0.0
+            else:
+                surrogates[c] = float(s.mean()) if len(s) else 0.0
+        m = ImputerModel(surrogates=surrogates)
+        m._inherit_params(self)
+        return m
+
+
+class ImputerModel(Model):
+    def _init_params(self):
+        Imputer._init_params(self)
+
+    def __init__(self, surrogates: Optional[Dict[str, float]] = None):
+        super().__init__()
+        self.surrogates = surrogates or {}
+
+    @property
+    def surrogateDF(self):
+        from ..frame.session import get_session
+        return get_session().createDataFrame(pd.DataFrame([self.surrogates]))
+
+    def _transform(self, df):
+        in_cols = list(self.getOrDefault("inputCols"))
+        out_cols = list(self.getOrDefault("outputCols") or in_cols)
+        surro = self.surrogates
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            for c, oc in zip(in_cols, out_cols):
+                s = pd.to_numeric(out[c], errors="coerce")
+                out[oc] = s.fillna(surro[c])
+            return out
+
+        return df._derive(fn)
+
+    def _extra_metadata(self):
+        return {"surrogates": self.surrogates}
+
+    def _load_state(self, path, meta):
+        self.surrogates = dict(meta.get("surrogates", {}))
+
+
+# --------------------------------------------------------------------------
+class StandardScaler(Estimator):
+    def _init_params(self):
+        self._declareParam("inputCol", doc="vector input")
+        self._declareParam("outputCol", doc="scaled output")
+        self._declareParam("withMean", default=False, doc="center")
+        self._declareParam("withStd", default=True, doc="scale to unit std")
+
+    def __init__(self, inputCol=None, outputCol=None, withMean=None, withStd=None):
+        super().__init__()
+        self._set(inputCol=inputCol, outputCol=outputCol, withMean=withMean,
+                  withStd=withStd)
+
+    def _fit(self, df) -> "StandardScalerModel":
+        from ._staging import extract_features
+        X = extract_features(df, self.getOrDefault("inputCol"))
+        mean = X.mean(axis=0)
+        std = X.std(axis=0, ddof=1)
+        m = StandardScalerModel(mean=mean, std=std)
+        m._inherit_params(self)
+        return m
+
+
+class StandardScalerModel(Model):
+    def _init_params(self):
+        StandardScaler._init_params(self)
+
+    def __init__(self, mean=None, std=None):
+        super().__init__()
+        self.mean = np.asarray(mean) if mean is not None else None
+        self.std = np.asarray(std) if std is not None else None
+
+    def _transform(self, df):
+        ic = self.getOrDefault("inputCol")
+        oc = self.getOrDefault("outputCol")
+        with_mean = bool(self.getOrDefault("withMean"))
+        with_std = bool(self.getOrDefault("withStd"))
+        mean, std = self.mean, np.where(self.std == 0, 1.0, self.std)
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            vecs = []
+            for v in out[ic]:
+                arr = v.toArray().astype(np.float64)
+                if with_mean:
+                    arr = arr - mean
+                if with_std:
+                    arr = arr / std
+                vecs.append(DenseVector(arr))
+            out[oc] = _as_object_series(vecs)
+            return out
+
+        return df._derive(fn)
+
+    def _save_state(self, path):
+        from .base import save_arrays
+        save_arrays(path, mean=self.mean, std=self.std)
+
+    def _load_state(self, path, meta):
+        from .base import load_arrays
+        d = load_arrays(path)
+        self.mean, self.std = d.get("mean"), d.get("std")
+
+
+# --------------------------------------------------------------------------
+class Bucketizer(Transformer):
+    def _init_params(self):
+        self._declareParam("splits", doc="bucket boundaries")
+        self._declareParam("inputCol", doc="input column")
+        self._declareParam("outputCol", doc="output column")
+        self._declareParam("handleInvalid", default="error", doc="error|skip|keep")
+
+    def __init__(self, splits=None, inputCol=None, outputCol=None, handleInvalid=None):
+        super().__init__()
+        self._set(splits=splits, inputCol=inputCol, outputCol=outputCol,
+                  handleInvalid=handleInvalid)
+
+    def _transform(self, df):
+        splits = np.asarray(self.getOrDefault("splits"), dtype=float)
+        ic, oc = self.getOrDefault("inputCol"), self.getOrDefault("outputCol")
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            x = pd.to_numeric(out[ic], errors="coerce").values
+            idx = np.digitize(x, splits[1:-1], right=False).astype(float)
+            idx[~np.isfinite(x)] = np.nan
+            out[oc] = idx
+            return out
+
+        return df._derive(fn)
+
+
+# --------------------------------------------------------------------------
+class RFormula(Estimator):
+    """R-style modeling formula: `label ~ .` / `label ~ a + b`
+    (`ML 04:110-117`, `Labs/ML 03L:33-39`). Strings are indexed + one-hot
+    encoded; numerics pass through; output = featuresCol + labelCol."""
+
+    def _init_params(self):
+        self._declareParam("formula", doc="R formula")
+        self._declareParam("featuresCol", default="features", doc="features output")
+        self._declareParam("labelCol", default="label", doc="label output")
+        self._declareParam("handleInvalid", default="error", doc="error|skip|keep")
+
+    def __init__(self, formula: Optional[str] = None, featuresCol=None,
+                 labelCol=None, handleInvalid=None):
+        super().__init__()
+        self._set(formula=formula, featuresCol=featuresCol, labelCol=labelCol,
+                  handleInvalid=handleInvalid)
+
+    def _fit(self, df) -> "RFormulaModel":
+        formula = self.getOrDefault("formula")
+        m = re.match(r"\s*(.+?)\s*~\s*(.+)\s*", formula)
+        if not m:
+            raise ValueError(f"cannot parse formula {formula!r}")
+        label, rhs = m.group(1), m.group(2)
+        sch = {f.name: f.dataType.simpleString() for f in df.schema.fields}
+        if rhs.strip() == ".":
+            terms = [c for c in df.columns if c != label]
+        else:
+            terms = [t.strip() for t in rhs.split("+")]
+        str_terms = [t for t in terms if sch.get(t) == "string"]
+        num_terms = [t for t in terms if t not in str_terms]
+
+        stages: List[Transformer] = []
+        assembled: List[str] = []
+        if str_terms:
+            idx_cols = [f"{c}__idx" for c in str_terms]
+            ohe_cols = [f"{c}__ohe" for c in str_terms]
+            invalid = self.getOrDefault("handleInvalid")
+            si = StringIndexer(inputCols=str_terms, outputCols=idx_cols,
+                               handleInvalid="skip" if invalid == "skip" else "keep")
+            si_model = si.fit(df)
+            indexed = si_model.transform(df)
+            ohe = OneHotEncoder(inputCols=idx_cols, outputCols=ohe_cols)
+            ohe_model = ohe.fit(indexed)
+            stages += [si_model, ohe_model]
+            assembled += ohe_cols
+        assembled += num_terms
+        va = VectorAssembler(inputCols=assembled,
+                             outputCol=self.getOrDefault("featuresCol"),
+                             handleInvalid="skip"
+                             if self.getOrDefault("handleInvalid") == "skip" else "keep")
+        stages.append(va)
+        model = RFormulaModel(stages=stages, label=label,
+                              labelCol=self.getOrDefault("labelCol"))
+        model._inherit_params(self)
+        return model
+
+
+class RFormulaModel(Model):
+    def _init_params(self):
+        RFormula._init_params(self)
+
+    def __init__(self, stages: Optional[List[Transformer]] = None,
+                 label: Optional[str] = None, labelCol: str = "label"):
+        super().__init__()
+        self.stages = stages or []
+        self.label_source = label
+        self._label_col = labelCol
+
+    def _transform(self, df):
+        cur = df
+        for s in self.stages:
+            cur = s.transform(cur)
+        src, dst = self.label_source, self._label_col
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            if src in out.columns and dst != src:
+                out[dst] = pd.to_numeric(out[src], errors="coerce")
+            return out
+
+        return cur._derive(fn)
+
+    def _extra_metadata(self):
+        return {"label_source": self.label_source, "label_col": self._label_col,
+                "n_stages": len(self.stages)}
+
+    def _save_state(self, path):
+        import os
+        for i, s in enumerate(self.stages):
+            s._save_to(os.path.join(path, "stages", f"{i:02d}_{s.uid}"))
+
+    def _load_state(self, path, meta):
+        import os
+        from .base import Saveable
+        self.label_source = meta.get("label_source")
+        self._label_col = meta.get("label_col", "label")
+        stage_dir = os.path.join(path, "stages")
+        self.stages = []
+        if os.path.exists(stage_dir):
+            for d in sorted(os.listdir(stage_dir)):
+                self.stages.append(Saveable.load(os.path.join(stage_dir, d)))

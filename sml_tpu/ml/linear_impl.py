@@ -1,0 +1,187 @@
+"""Distributed linear-model solvers (SURVEY §2.2 P2).
+
+The reference's LinearRegression trains by "matrix decomposition … else
+L-BFGS", with per-iteration gradients tree-aggregated from executors
+(`SML/Labs/ML 02L - Linear Regression I Lab.py:66-77`). Here the same math is
+two jitted shard_map programs over the mesh's data axis:
+
+- one pass building the Gram block `[X 1]^T [X 1]` and `[X 1]^T y` per chip,
+  `psum`-reduced over ICI (the treeAggregate replacement). d is small, so the
+  (d+1)² solve happens replicated on every chip.
+- for L1/elastic-net and logistic loss, an iterative program (FISTA on the
+  Gram for least squares; IRLS Newton for logistic) whose per-iteration
+  reductions are the same psum.
+
+All passes are masked so row padding (static shapes for XLA) is inert.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import collectives as coll
+from ._staging import run_data_parallel
+
+
+class LinearFit(NamedTuple):
+    coefficients: np.ndarray
+    intercept: float
+    iterations: int
+
+
+def gram_stats(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray, float]:
+    """One data-parallel pass: (A = [X 1]^T [X 1], b = [X 1]^T y, n)."""
+
+    def pass_fn(Xb, yb, mask):
+        Xb = Xb * mask[:, None]
+        yb = yb * mask
+        ones = mask[:, None]
+        Xa = jnp.concatenate([Xb, ones], axis=1)
+        A = coll.psum(Xa.T @ Xa)            # MXU matmul then ICI allreduce
+        b = coll.psum(Xa.T @ yb)
+        n = coll.psum(jnp.sum(mask))
+        return A, b, n
+
+    A, b, n = run_data_parallel(pass_fn, X.astype(np.float32), y.astype(np.float32))
+    return np.asarray(A, dtype=np.float64), np.asarray(b, dtype=np.float64), float(n)
+
+
+def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
+               elasticNetParam: float = 0.0, fitIntercept: bool = True,
+               standardization: bool = True, maxIter: int = 100,
+               tol: float = 1e-6) -> LinearFit:
+    """Least squares with (optional) elastic-net penalty on the Gram
+    sufficient statistics. Matches MLlib semantics: the penalty applies to
+    standardized coefficients; the intercept is never penalized."""
+    n, d = X.shape
+    A, b, n_f = gram_stats(X, y)
+    # moments from the Gram pass (last row/col hold the sums)
+    sx = A[-1, :d] / n_f
+    sy = b[-1] / n_f
+    xx_diag = np.diag(A)[:d] / n_f
+    std = np.sqrt(np.maximum(xx_diag - sx ** 2, 1e-12))
+    lam = float(regParam)
+    alpha = float(elasticNetParam)
+
+    if lam == 0.0 or alpha == 0.0:
+        # closed form: (A + λ n S²)⁻¹ b with S scaling the standardized L2
+        # penalty back to raw space; intercept row/col unpenalized
+        reg = np.zeros_like(A)
+        if lam > 0:
+            scale = (1.0 / std ** 2) if standardization else np.ones(d)
+            reg[:d, :d] = np.diag(lam * n_f * scale)
+        if not fitIntercept:
+            A = A[:d, :d]
+            b = b[:d]
+            sol = np.linalg.solve(A + reg[:d, :d] + 1e-9 * np.eye(d), b)
+            return LinearFit(sol, 0.0, 1)
+        sol = np.linalg.solve(A + reg + 1e-9 * np.eye(d + 1), b)
+        return LinearFit(sol[:d], float(sol[d]), 1)
+
+    # elastic net via FISTA on the (tiny, replicated) Gram — centered space
+    Axx = A[:d, :d] / n_f - np.outer(sx, sx)
+    bxy = b[:d] / n_f - sx * sy
+    if standardization:
+        Axx = Axx / np.outer(std, std)
+        bxy = bxy / std
+    L = float(np.linalg.eigvalsh(Axx).max()) + lam * (1 - alpha)
+    l1 = lam * alpha
+    l2 = lam * (1 - alpha)
+
+    def prox_step(w):
+        g = Axx @ w - bxy + l2 * w
+        z = w - g / L
+        return jnp.sign(z) * jnp.maximum(jnp.abs(z) - l1 / L, 0.0)
+
+    @jax.jit
+    def fista(w0):
+        def body(carry, _):
+            w, v, t = carry
+            w_new = prox_step(v)
+            t_new = (1 + jnp.sqrt(1 + 4 * t * t)) / 2
+            v_new = w_new + ((t - 1) / t_new) * (w_new - w)
+            return (w_new, v_new, t_new), jnp.max(jnp.abs(w_new - w))
+        (w, _, _), deltas = jax.lax.scan(body, (w0, w0, jnp.float32(1.0)),
+                                         None, length=maxIter)
+        return w, deltas
+
+    w, _ = fista(jnp.zeros(d, dtype=jnp.float32))
+    w = np.asarray(w, dtype=np.float64)
+    if standardization:
+        w = w / std
+    intercept = float(sy - sx @ w) if fitIntercept else 0.0
+    return LinearFit(w, intercept, maxIter)
+
+
+def fit_logistic(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
+                 elasticNetParam: float = 0.0, fitIntercept: bool = True,
+                 maxIter: int = 100, tol: float = 1e-7) -> LinearFit:
+    """Binomial logistic regression by IRLS Newton steps; the per-iteration
+    `X^T W X` / gradient reduction is a psum over the mesh — the exact shape
+    of MLlib's treeAggregate-per-iteration loop."""
+    n, d = X.shape
+    lam = float(regParam)
+    l2 = lam * (1 - float(elasticNetParam))
+    l1 = lam * float(elasticNetParam)
+
+    def newton_pass(Xb, yb, wb, mask):
+        ones = mask[:, None]
+        Xa = jnp.concatenate([Xb * mask[:, None], ones], axis=1)
+        eta = Xa @ wb
+        p = jax.nn.sigmoid(eta)
+        Wdiag = jnp.maximum(p * (1 - p), 1e-6) * mask
+        grad = coll.psum(Xa.T @ ((p - yb) * mask))
+        hess = coll.psum((Xa * Wdiag[:, None]).T @ Xa)
+        ll = coll.psum(jnp.sum(mask * (yb * jax.nn.log_sigmoid(eta)
+                                       + (1 - yb) * jax.nn.log_sigmoid(-eta))))
+        return grad, hess, ll
+
+    w = np.zeros(d + 1, dtype=np.float32)
+    n_f = float(len(y))
+    prev_ll = -np.inf
+    iters = 0
+    for it in range(maxIter):
+        grad, hess, ll = run_data_parallel(
+            lambda Xb, yb, mask, _w=jnp.asarray(w): newton_pass(Xb, yb, _w, mask),
+            X.astype(np.float32), y.astype(np.float32))
+        grad = np.asarray(grad, dtype=np.float64)
+        hess = np.asarray(hess, dtype=np.float64)
+        if l2 > 0:
+            grad[:d] += l2 * n_f * w[:d]
+            hess[:d, :d] += l2 * n_f * np.eye(d)
+        step = np.linalg.solve(hess + 1e-8 * np.eye(d + 1), grad)
+        w_new = w - step.astype(np.float32)
+        if l1 > 0:  # proximal shrink on coefficients (not intercept)
+            scale = np.abs(np.diag(hess)[:d]) + 1e-12
+            w_new[:d] = np.sign(w_new[:d]) * np.maximum(
+                np.abs(w_new[:d]) - l1 * n_f / scale, 0.0)
+        iters = it + 1
+        if np.max(np.abs(w_new - w)) < tol:
+            w = w_new
+            break
+        if float(ll) < prev_ll - 1e3:  # diverging: damp
+            w = (w + w_new) / 2
+        else:
+            w = w_new
+        prev_ll = float(ll)
+    if not fitIntercept:
+        return LinearFit(np.asarray(w[:d], dtype=np.float64), 0.0, iters)
+    return LinearFit(np.asarray(w[:d], dtype=np.float64), float(w[d]), iters)
+
+
+@jax.jit
+def _affine(X, w, b):
+    return X @ w + b
+
+
+def predict_linear(X: np.ndarray, coefficients: np.ndarray, intercept: float) -> np.ndarray:
+    if X.size == 0:
+        return np.zeros((X.shape[0],))
+    out = _affine(jnp.asarray(X, dtype=jnp.float32),
+                  jnp.asarray(coefficients, dtype=jnp.float32),
+                  jnp.float32(intercept))
+    return np.asarray(out, dtype=np.float64)

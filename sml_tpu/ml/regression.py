@@ -1,0 +1,164 @@
+"""Regression estimators.
+
+`LinearRegression` (`SML/ML 02 - Linear Regression I.py:84-123`): fit via the
+sharded Gram/psum solvers in `linear_impl`, expose `coefficients`,
+`intercept`, and a training `summary` (rmse/r2) like the reference inspects.
+Tree regressors (`SML/ML 06 - Decision Trees.py`, `ML 07`, `ML 11`) ride the
+histogram engine in `tree_impl`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from .base import Estimator, Model, load_arrays, save_arrays
+from .feature import _as_object_series
+from .linalg import DenseVector
+from ._staging import extract_features, extract_xy
+from . import linear_impl
+
+
+class _PredictorParams:
+    """Shared param declarations for supervised estimators/models."""
+
+    def _declare_predictor_params(self):
+        self._declareParam("featuresCol", default="features", doc="features column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+
+
+class LinearRegressionSummary:
+    def __init__(self, rmse: float, r2: float, mae: float, explainedVariance: float,
+                 numInstances: int, objectiveHistory=None):
+        self.rootMeanSquaredError = rmse
+        self.r2 = r2
+        self.meanAbsoluteError = mae
+        self.meanSquaredError = rmse ** 2
+        self.explainedVariance = explainedVariance
+        self.numInstances = numInstances
+        self.objectiveHistory = objectiveHistory or []
+
+
+class LinearRegression(Estimator, _PredictorParams):
+    def _init_params(self):
+        self._declare_predictor_params()
+        self._declareParam("regParam", default=0.0, doc="regularization strength")
+        self._declareParam("elasticNetParam", default=0.0, doc="L1 mixing in [0,1]")
+        self._declareParam("maxIter", default=100, doc="max iterations")
+        self._declareParam("tol", default=1e-6, doc="convergence tolerance")
+        self._declareParam("fitIntercept", default=True, doc="fit intercept")
+        self._declareParam("standardization", default=True, doc="standardize before penalty")
+        self._declareParam("solver", default="auto", doc="auto|normal|l-bfgs")
+        self._declareParam("weightCol", doc="instance weight column")
+
+    def __init__(self, featuresCol=None, labelCol=None, predictionCol=None,
+                 regParam=None, elasticNetParam=None, maxIter=None, tol=None,
+                 fitIntercept=None, standardization=None, solver=None, weightCol=None):
+        super().__init__()
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, regParam=regParam,
+                  elasticNetParam=elasticNetParam, maxIter=maxIter, tol=tol,
+                  fitIntercept=fitIntercept, standardization=standardization,
+                  solver=solver, weightCol=weightCol)
+
+    def setLabelCol(self, v):
+        return self._set(labelCol=v)
+
+    def setFeaturesCol(self, v):
+        return self._set(featuresCol=v)
+
+    def _fit(self, df) -> "LinearRegressionModel":
+        pdf = df.toPandas()
+        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+                             self.getOrDefault("labelCol"))
+        ok = np.isfinite(y)
+        X, y = X[ok], y[ok]
+        res = linear_impl.fit_linear(
+            X, y,
+            regParam=float(self.getOrDefault("regParam")),
+            elasticNetParam=float(self.getOrDefault("elasticNetParam")),
+            fitIntercept=bool(self.getOrDefault("fitIntercept")),
+            standardization=bool(self.getOrDefault("standardization")),
+            maxIter=int(self.getOrDefault("maxIter")),
+            tol=float(self.getOrDefault("tol")))
+        model = LinearRegressionModel(coefficients=res.coefficients,
+                                      intercept=res.intercept)
+        model._inherit_params(self)
+        pred = linear_impl.predict_linear(X, res.coefficients, res.intercept)
+        resid = y - pred
+        var_y = float(np.var(y))
+        mse = float(np.mean(resid ** 2))
+        model._summary = LinearRegressionSummary(
+            rmse=float(np.sqrt(mse)), r2=1 - mse / var_y if var_y else 0.0,
+            mae=float(np.mean(np.abs(resid))),
+            explainedVariance=float(np.var(pred)), numInstances=len(y))
+        return model
+
+
+class LinearRegressionModel(Model, _PredictorParams):
+    def _init_params(self):
+        LinearRegression._init_params(self)
+
+    def __init__(self, coefficients=None, intercept: float = 0.0):
+        super().__init__()
+        self._coefficients = np.asarray(coefficients, dtype=np.float64) \
+            if coefficients is not None else None
+        self._intercept = float(intercept)
+        self._summary: Optional[LinearRegressionSummary] = None
+
+    @property
+    def coefficients(self) -> DenseVector:
+        return DenseVector(self._coefficients)
+
+    @property
+    def intercept(self) -> float:
+        return self._intercept
+
+    @property
+    def summary(self) -> LinearRegressionSummary:
+        return self._summary
+
+    @property
+    def numFeatures(self) -> int:
+        return int(self._coefficients.shape[0])
+
+    def evaluate(self, df) -> LinearRegressionSummary:
+        X, y, _ = extract_xy(df.toPandas(), self.getOrDefault("featuresCol"),
+                             self.getOrDefault("labelCol"))
+        pred = linear_impl.predict_linear(X, self._coefficients, self._intercept)
+        resid = y - pred
+        var_y = float(np.var(y))
+        mse = float(np.mean(resid ** 2))
+        return LinearRegressionSummary(
+            rmse=float(np.sqrt(mse)), r2=1 - mse / var_y if var_y else 0.0,
+            mae=float(np.mean(np.abs(resid))),
+            explainedVariance=float(np.var(pred)), numInstances=len(y))
+
+    def _transform(self, df):
+        fc = self.getOrDefault("featuresCol")
+        oc = self.getOrDefault("predictionCol")
+        w, b = self._coefficients, self._intercept
+
+        def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
+            out = pdf.copy()
+            if len(out) == 0:
+                out[oc] = pd.Series(dtype=float)
+                return out
+            X = extract_features(out, fc)
+            out[oc] = linear_impl.predict_linear(X, w, b)
+            return out
+
+        return df._derive(fn)
+
+    def _save_state(self, path):
+        save_arrays(path, coefficients=self._coefficients,
+                    intercept=np.asarray([self._intercept]))
+
+    def _load_state(self, path, meta):
+        d = load_arrays(path)
+        self._coefficients = d["coefficients"]
+        self._intercept = float(d["intercept"][0])
+        self._summary = None
