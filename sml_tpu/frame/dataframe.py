@@ -84,6 +84,11 @@ class DataFrame:
         self._schema_hint = schema
         self._parts: Optional[Partitions] = None
         self._offsets: Optional[List[int]] = None
+        # ML column attributes (e.g. categorical cardinality set by
+        # StringIndexer, per-slot metadata set by VectorAssembler) — the
+        # equivalent of Spark ML's column metadata that tree learners read
+        # for maxBins semantics (`ML 06:91-126`).
+        self._ml_attrs: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------ core
     @classmethod
@@ -131,7 +136,9 @@ class DataFrame:
             ctxs = parent._contexts()
             return [fn(p, c) for p, c in zip(parts, ctxs)]
 
-        return DataFrame(compute, session=self._session, schema=schema)
+        out = DataFrame(compute, session=self._session, schema=schema)
+        out._ml_attrs = dict(self._ml_attrs)
+        return out
 
     # ------------------------------------------------------------ metadata
     @property

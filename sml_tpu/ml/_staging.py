@@ -67,22 +67,26 @@ def stage_sharded(*arrays: np.ndarray):
     return (*outs, mask_dev, n_true)
 
 
-def data_parallel(fn: Callable, *, out_replicated: bool = True) -> Callable:
+def data_parallel(fn: Callable, *, out_replicated: bool = True,
+                  replicated_argnums: Tuple[int, ...] = ()) -> Callable:
     """jit(shard_map(fn)) over the active mesh's data axis.
 
     `fn` sees per-chip row blocks and may call `parallel.collectives.psum`
     etc. on the "data" axis; outputs are replicated (each chip returns the
     same reduced value) unless out_replicated=False (then row-sharded).
+    Args listed in `replicated_argnums` (rng keys, small parameter vectors)
+    are broadcast to every chip instead of row-sharded.
     """
     mesh = meshlib.get_mesh()
-    in_spec = P(meshlib.DATA_AXIS)
     out_spec = P() if out_replicated else P(meshlib.DATA_AXIS)
 
-    def spec_for(x):
+    def spec_for(i, x):
+        if i in replicated_argnums:
+            return P()
         return P(*([meshlib.DATA_AXIS] + [None] * (np.ndim(x) - 1)))
 
     def wrapped(*args):
-        specs = tuple(spec_for(a) for a in args)
+        specs = tuple(spec_for(i, a) for i, a in enumerate(args))
         mapped = shard_map(fn, mesh=mesh, in_specs=specs,
                            out_specs=out_spec, check_vma=False)
         return mapped(*args)

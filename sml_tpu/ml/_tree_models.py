@@ -1,0 +1,537 @@
+"""Tree estimators/models over the histogram engine (`tree_impl`).
+
+Surface parity targets:
+- `DecisionTreeRegressor` + `maxBins` failure semantics and
+  `featureImportances` — `SML/ML 06 - Decision Trees.py:73-154`
+- `RandomForestRegressor/Classifier` (numTrees, maxDepth,
+  featureSubsetStrategy) — `SML/ML 07 - Random Forests and Hyperparameter
+  Tuning.py:41-77`, `SML/Labs/ML 07L - Hyperparameter Tuning Lab.py`
+- GBT (`SML/ML 11 - XGBoost.py:109` mentions GBTRegressor; the
+  XGBoost-equivalent surface lives in `sml_tpu.xgboost`)
+
+All learners share one second-order histogram program; the differences are
+the (grad, hess) stream, bootstrap weights, and per-node feature subspaces.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from .base import Estimator, Model, load_arrays, save_arrays
+from .feature import _as_object_series
+from .linalg import DenseVector
+from ._staging import extract_features, extract_xy
+from . import tree_impl
+from .tree_impl import (Binning, FittedTree, TreeSpec, bin_with,
+                        feature_importances, fit_tree, predict_forest,
+                        stage_aligned, stage_tree_data)
+
+
+def _categorical_slots(df, featuresCol: str) -> Dict[int, int]:
+    attrs = getattr(df, "_ml_attrs", {}).get(featuresCol) or {}
+    return {int(k): int(v) for k, v in (attrs.get("slots") or {}).items()}
+
+
+class _TreeParams:
+    def _declare_tree_params(self):
+        self._declareParam("featuresCol", default="features", doc="features column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+        self._declareParam("maxDepth", default=5, doc="max tree depth")
+        self._declareParam("maxBins", default=32, doc="max discretization bins")
+        self._declareParam("minInstancesPerNode", default=1, doc="min rows per child")
+        self._declareParam("minInfoGain", default=0.0, doc="min split gain")
+        self._declareParam("seed", default=None, doc="random seed")
+
+
+def _feature_k(strategy: str, F: int, is_classification: bool) -> int:
+    s = str(strategy).lower()
+    if s == "auto":
+        s = "sqrt" if is_classification else "onethird"
+    if s == "all":
+        return F
+    if s == "sqrt":
+        return max(1, int(math.sqrt(F)))
+    if s == "log2":
+        return max(1, int(math.log2(F)))
+    if s == "onethird":
+        return max(1, int(F / 3))
+    try:
+        v = float(strategy)
+        if v <= 1.0:
+            return max(1, int(v * F))
+        return min(F, int(v))
+    except ValueError:
+        raise ValueError(f"unknown featureSubsetStrategy {strategy!r}")
+
+
+class _EnsembleSpec:
+    """Host-side description of a fitted ensemble (persisted whole)."""
+
+    def __init__(self, trees: List[FittedTree], depth: int, binning: Binning,
+                 tree_weights: Optional[np.ndarray], base: float,
+                 n_features: int, mode: str):
+        self.trees = trees
+        self.depth = depth
+        self.binning = binning
+        self.tree_weights = tree_weights  # None → average
+        self.base = base
+        self.n_features = n_features
+        self.mode = mode  # "regression" | "binary"
+
+    def predict_margin(self, X: np.ndarray) -> np.ndarray:
+        binned = bin_with(X, self.binning)
+        return self.base + predict_forest(binned, self.trees, self.depth,
+                                          self.tree_weights)
+
+    def save(self, path: str) -> None:
+        remap_keys = sorted(self.binning.cat_remap)
+        save_arrays(
+            path,
+            split_feature=np.stack([t.split_feature for t in self.trees]),
+            split_bin=np.stack([t.split_bin for t in self.trees]),
+            leaf_value=np.stack([t.leaf_value for t in self.trees]),
+            gain=np.stack([t.gain for t in self.trees]),
+            cover=np.stack([t.cover for t in self.trees]),
+            edges=self.binning.edges,
+            tree_weights=(self.tree_weights if self.tree_weights is not None
+                          else np.zeros(0)),
+            scalars=np.asarray([self.depth, self.base, self.n_features,
+                                1.0 if self.mode == "binary" else 0.0,
+                                len(remap_keys)], dtype=np.float64),
+            remap_slots=np.asarray(remap_keys, dtype=np.int64),
+            **{f"remap_{k}": self.binning.cat_remap[k] for k in remap_keys},
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "_EnsembleSpec":
+        d = load_arrays(path)
+        depth, base, n_features, is_bin, _ = d["scalars"]
+        remap = {int(k): d[f"remap_{int(k)}"] for k in d["remap_slots"]}
+        trees = [FittedTree(sf, sb, lv, g, c) for sf, sb, lv, g, c in
+                 zip(d["split_feature"], d["split_bin"], d["leaf_value"],
+                     d["gain"], d["cover"])]
+        tw = d["tree_weights"] if len(d["tree_weights"]) else None
+        return cls(trees, int(depth), Binning(edges=d["edges"], cat_remap=remap),
+                   tw, float(base), int(n_features),
+                   "binary" if is_bin else "regression")
+
+
+def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
+                  max_depth: int, max_bins: int, min_instances: int,
+                  min_info_gain: float, n_trees: int, feature_k: Optional[int],
+                  bootstrap: bool, subsample: float, seed: int, loss: str,
+                  step_size: float = 0.1, reg_lambda: float = 0.0,
+                  gamma: float = 0.0, boosting: bool = False,
+                  missing: Optional[float] = None) -> _EnsembleSpec:
+    """The one training loop behind every tree learner."""
+    if missing is not None and not np.isnan(missing):
+        X = X.copy()
+        X[X == missing] = np.nan
+    staged = stage_tree_data(X, y, max_bins, categorical)
+    F = X.shape[1]
+    spec = TreeSpec(max_depth=max_depth, n_bins=max_bins, n_features=F,
+                    feature_k=feature_k or F, min_instances=min_instances,
+                    min_info_gain=min_info_gain, reg_lambda=reg_lambda,
+                    gamma=gamma)
+    rng = np.random.default_rng(seed)
+    trees: List[FittedTree] = []
+    n = len(y)
+
+    if not boosting:
+        g_dev = stage_aligned(-y.astype(np.float32), staged.n_padded)
+        h_dev = stage_aligned(np.ones(n, dtype=np.float32), staged.n_padded)
+        for t in range(n_trees):
+            if bootstrap and n_trees > 1:
+                w = rng.poisson(subsample, n).astype(np.float32)
+            elif subsample < 1.0:
+                w = (rng.random(n) < subsample).astype(np.float32)
+            else:
+                w = np.ones(n, dtype=np.float32)
+            w_dev = stage_aligned(w, staged.n_padded)
+            import jax
+            feat_key = jax.random.key_data(jax.random.PRNGKey(seed + 7919 * t))
+            trees.append(fit_tree(staged.binned_dev, g_dev, h_dev, w_dev,
+                                  spec, feat_key=feat_key))
+        mode = "binary" if loss == "logistic" else "regression"
+        return _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0, F, mode)
+
+    # boosting
+    if loss == "logistic":
+        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+        base = float(np.log(p0 / (1 - p0)))
+    else:
+        base = float(y.mean())
+    margin = np.full(n, base, dtype=np.float32)
+    w_dev = stage_aligned(np.ones(n, dtype=np.float32), staged.n_padded)
+    import jax
+    for t in range(n_trees):
+        if loss == "logistic":
+            p = 1.0 / (1.0 + np.exp(-margin))
+            grad = (p - y).astype(np.float32)
+            hess = np.maximum(p * (1 - p), 1e-6).astype(np.float32)
+        else:
+            grad = (margin - y).astype(np.float32)
+            hess = np.ones(n, dtype=np.float32)
+        g_dev = stage_aligned(grad, staged.n_padded)
+        h_dev = stage_aligned(hess, staged.n_padded)
+        if subsample < 1.0:
+            w = (rng.random(n) < subsample).astype(np.float32)
+            w_dev_t = stage_aligned(w, staged.n_padded)
+        else:
+            w_dev_t = w_dev
+        feat_key = jax.random.key_data(jax.random.PRNGKey(seed + 7919 * t))
+        tree = fit_tree(staged.binned_dev, g_dev, h_dev, w_dev_t, spec,
+                        feat_key=feat_key)
+        trees.append(tree)
+        margin = margin + step_size * tree_impl.predict_tree(
+            staged.binned, tree, max_depth).astype(np.float32)
+    weights = np.full(len(trees), step_size, dtype=np.float32)
+    mode = "binary" if loss == "logistic" else "regression"
+    return _EnsembleSpec(trees, max_depth, staged.binning, weights, base, F, mode)
+
+
+# ---------------------------------------------------------------------------
+class _TreeModelBase(Model, _TreeParams):
+    """Shared transform/persistence for tree ensemble models."""
+
+    def __init__(self, spec: Optional[_EnsembleSpec] = None):
+        super().__init__()
+        self._spec = spec
+
+    @property
+    def featureImportances(self) -> DenseVector:
+        return DenseVector(feature_importances(self._spec.trees,
+                                               self._spec.n_features))
+
+    @property
+    def numFeatures(self) -> int:
+        return self._spec.n_features
+
+    def getNumTrees(self) -> int:
+        return len(self._spec.trees)
+
+    @property
+    def treeWeights(self) -> List[float]:
+        if self._spec.tree_weights is None:
+            return [1.0] * len(self._spec.trees)
+        return [float(w) for w in self._spec.tree_weights]
+
+    @property
+    def toDebugString(self) -> str:
+        lines = [f"{type(self).__name__} with {len(self._spec.trees)} trees, "
+                 f"depth {self._spec.depth}"]
+        t0 = self._spec.trees[0]
+        for node in range(min(len(t0.split_feature), 15)):
+            f = int(t0.split_feature[node])
+            if f >= 0:
+                lines.append(f"  node {node}: split feature {f} "
+                             f"@bin {int(t0.split_bin[node])} "
+                             f"gain {float(t0.gain[node]):.4f}")
+            else:
+                lines.append(f"  node {node}: leaf "
+                             f"value {float(t0.leaf_value[node]):.4f}")
+        return "\n".join(lines)
+
+    def _margin(self, pdf: pd.DataFrame) -> np.ndarray:
+        X = extract_features(pdf, self.getOrDefault("featuresCol"))
+        return self._spec.predict_margin(X)
+
+    def _save_state(self, path):
+        self._spec.save(path)
+
+    def _load_state(self, path, meta):
+        self._spec = _EnsembleSpec.load(path)
+
+
+class _TreeRegressionModel(_TreeModelBase):
+    def _transform(self, df):
+        oc = self.getOrDefault("predictionCol")
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            if len(out) == 0:
+                out[oc] = pd.Series(dtype=float)
+                return out
+            out[oc] = self._margin(out)
+            return out
+
+        return df._derive(fn)
+
+
+class _TreeClassificationModel(_TreeModelBase):
+    def _transform(self, df):
+        oc = self.getOrDefault("predictionCol")
+        rc = self.getOrDefault("rawPredictionCol")
+        prc = self.getOrDefault("probabilityCol")
+
+        def fn(pdf, ctx):
+            out = pdf.copy()
+            if len(out) == 0:
+                for c in (rc, prc):
+                    out[c] = pd.Series(dtype=object)
+                out[oc] = pd.Series(dtype=float)
+                return out
+            m = self._margin(out)
+            if self._spec.tree_weights is None:  # forest of probability leaves
+                p1 = np.clip(m, 0.0, 1.0)
+            else:  # boosted margins
+                p1 = 1.0 / (1.0 + np.exp(-m))
+            out[rc] = _as_object_series([DenseVector([1 - p, p]) for p in p1])
+            out[prc] = _as_object_series([DenseVector([1 - p, p]) for p in p1])
+            out[oc] = (p1 > 0.5).astype(float)
+            return out
+
+        return df._derive(fn)
+
+
+# ------------------------------------------------------------ estimators
+class _TreeEstimatorBase(Estimator, _TreeParams):
+    _is_classifier = False
+    _loss = "squared"
+
+    def _extract(self, df):
+        pdf = df.toPandas()
+        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+                             self.getOrDefault("labelCol"))
+        ok = np.isfinite(y)
+        return X[ok], y[ok], _categorical_slots(df, self.getOrDefault("featuresCol"))
+
+    def _seed(self) -> int:
+        s = self.getOrDefault("seed")
+        return int(s) if s is not None else 17
+
+
+class DecisionTreeRegressor(_TreeEstimatorBase):
+    def _init_params(self):
+        self._declare_tree_params()
+
+    def __init__(self, featuresCol=None, labelCol=None, predictionCol=None,
+                 maxDepth=None, maxBins=None, minInstancesPerNode=None,
+                 minInfoGain=None, seed=None):
+        super().__init__()
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, maxDepth=maxDepth,
+                  maxBins=maxBins, minInstancesPerNode=minInstancesPerNode,
+                  minInfoGain=minInfoGain, seed=seed)
+
+    def setMaxBins(self, v):
+        return self._set(maxBins=v)
+
+    def setMaxDepth(self, v):
+        return self._set(maxDepth=v)
+
+    def _fit(self, df):
+        X, y, cat = self._extract(df)
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            n_trees=1, feature_k=None, bootstrap=False, subsample=1.0,
+            seed=self._seed(), loss="squared")
+        m = DecisionTreeRegressionModel(spec)
+        m._inherit_params(self)
+        return m
+
+
+class DecisionTreeRegressionModel(_TreeRegressionModel):
+    def _init_params(self):
+        DecisionTreeRegressor._init_params(self)
+
+    @property
+    def depth(self) -> int:
+        return self._spec.depth
+
+
+class DecisionTreeClassifier(_TreeEstimatorBase):
+    _is_classifier = True
+
+    def _init_params(self):
+        self._declare_tree_params()
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="raw scores")
+        self._declareParam("probabilityCol", default="probability", doc="probabilities")
+
+    def __init__(self, featuresCol=None, labelCol=None, predictionCol=None,
+                 maxDepth=None, maxBins=None, minInstancesPerNode=None,
+                 minInfoGain=None, seed=None):
+        super().__init__()
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, maxDepth=maxDepth,
+                  maxBins=maxBins, minInstancesPerNode=minInstancesPerNode,
+                  minInfoGain=minInfoGain, seed=seed)
+
+    def setMaxBins(self, v):
+        return self._set(maxBins=v)
+
+    def _fit(self, df):
+        X, y, cat = self._extract(df)
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            n_trees=1, feature_k=None, bootstrap=False, subsample=1.0,
+            seed=self._seed(), loss="logistic")
+        m = DecisionTreeClassificationModel(spec)
+        m._inherit_params(self)
+        return m
+
+
+class DecisionTreeClassificationModel(_TreeClassificationModel):
+    def _init_params(self):
+        DecisionTreeClassifier._init_params(self)
+
+
+class RandomForestRegressor(_TreeEstimatorBase):
+    def _init_params(self):
+        self._declare_tree_params()
+        self._declareParam("numTrees", default=20, doc="number of trees")
+        self._declareParam("featureSubsetStrategy", default="auto",
+                           doc="auto|all|sqrt|log2|onethird|fraction")
+        self._declareParam("subsamplingRate", default=1.0, doc="bootstrap rate")
+
+    def __init__(self, featuresCol=None, labelCol=None, predictionCol=None,
+                 maxDepth=None, maxBins=None, numTrees=None,
+                 featureSubsetStrategy=None, subsamplingRate=None,
+                 minInstancesPerNode=None, minInfoGain=None, seed=None):
+        super().__init__()
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, maxDepth=maxDepth,
+                  maxBins=maxBins, numTrees=numTrees,
+                  featureSubsetStrategy=featureSubsetStrategy,
+                  subsamplingRate=subsamplingRate,
+                  minInstancesPerNode=minInstancesPerNode,
+                  minInfoGain=minInfoGain, seed=seed)
+
+    def setMaxBins(self, v):
+        return self._set(maxBins=v)
+
+    def _fit(self, df):
+        X, y, cat = self._extract(df)
+        F = X.shape[1]
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            n_trees=int(self.getOrDefault("numTrees")),
+            feature_k=_feature_k(self.getOrDefault("featureSubsetStrategy"),
+                                 F, self._is_classifier),
+            bootstrap=True,
+            subsample=float(self.getOrDefault("subsamplingRate")),
+            seed=self._seed(), loss="squared")
+        m = RandomForestRegressionModel(spec)
+        m._inherit_params(self)
+        return m
+
+
+class RandomForestRegressionModel(_TreeRegressionModel):
+    def _init_params(self):
+        RandomForestRegressor._init_params(self)
+
+
+class RandomForestClassifier(RandomForestRegressor):
+    _is_classifier = True
+
+    def _init_params(self):
+        RandomForestRegressor._init_params(self)
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="raw scores")
+        self._declareParam("probabilityCol", default="probability", doc="probabilities")
+
+    def _fit(self, df):
+        X, y, cat = self._extract(df)
+        F = X.shape[1]
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            n_trees=int(self.getOrDefault("numTrees")),
+            feature_k=_feature_k(self.getOrDefault("featureSubsetStrategy"),
+                                 F, True),
+            bootstrap=True,
+            subsample=float(self.getOrDefault("subsamplingRate")),
+            seed=self._seed(), loss="logistic")
+        m = RandomForestClassificationModel(spec)
+        m._inherit_params(self)
+        return m
+
+
+class RandomForestClassificationModel(_TreeClassificationModel):
+    def _init_params(self):
+        RandomForestClassifier._init_params(self)
+
+
+class GBTRegressor(_TreeEstimatorBase):
+    def _init_params(self):
+        self._declare_tree_params()
+        self._declareParam("maxIter", default=20, doc="boosting rounds")
+        self._declareParam("stepSize", default=0.1, doc="learning rate")
+        self._declareParam("subsamplingRate", default=1.0, doc="row subsample per round")
+
+    def __init__(self, featuresCol=None, labelCol=None, predictionCol=None,
+                 maxDepth=None, maxBins=None, maxIter=None, stepSize=None,
+                 subsamplingRate=None, minInstancesPerNode=None,
+                 minInfoGain=None, seed=None):
+        super().__init__()
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, maxDepth=maxDepth,
+                  maxBins=maxBins, maxIter=maxIter, stepSize=stepSize,
+                  subsamplingRate=subsamplingRate,
+                  minInstancesPerNode=minInstancesPerNode,
+                  minInfoGain=minInfoGain, seed=seed)
+
+    _loss = "squared"
+    _model_cls = None  # set below
+
+    def _fit(self, df):
+        X, y, cat = self._extract(df)
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            n_trees=int(self.getOrDefault("maxIter")), feature_k=None,
+            bootstrap=False,
+            subsample=float(self.getOrDefault("subsamplingRate")),
+            seed=self._seed(), loss=self._loss,
+            step_size=float(self.getOrDefault("stepSize")), boosting=True)
+        m = self._model_cls(spec)
+        m._inherit_params(self)
+        return m
+
+
+class GBTRegressionModel(_TreeRegressionModel):
+    def _init_params(self):
+        GBTRegressor._init_params(self)
+
+
+GBTRegressor._model_cls = GBTRegressionModel
+
+
+class GBTClassifier(GBTRegressor):
+    _is_classifier = True
+    _loss = "logistic"
+
+    def _init_params(self):
+        GBTRegressor._init_params(self)
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="raw scores")
+        self._declareParam("probabilityCol", default="probability", doc="probabilities")
+
+
+class GBTClassificationModel(_TreeClassificationModel):
+    def _init_params(self):
+        GBTClassifier._init_params(self)
+
+
+GBTClassifier._model_cls = GBTClassificationModel

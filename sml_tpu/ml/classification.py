@@ -19,6 +19,10 @@ from .feature import _as_object_series
 from .linalg import DenseVector
 from ._staging import extract_features, extract_xy
 from . import linear_impl
+from ._tree_models import (DecisionTreeClassificationModel,
+                           DecisionTreeClassifier, GBTClassificationModel,
+                           GBTClassifier, RandomForestClassificationModel,
+                           RandomForestClassifier)
 
 
 class BinaryLogisticRegressionSummary:

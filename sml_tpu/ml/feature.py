@@ -89,7 +89,27 @@ class VectorAssembler(Transformer):
             out[out_col] = _as_object_series([DenseVector(r) for r in mat])
             return out
 
-        return df._derive(fn)
+        res = df._derive(fn)
+        # per-slot feature metadata: which assembled slots are categorical
+        # (slot → cardinality), consumed by tree learners
+        slots: Dict[int, int] = {}
+        pos = 0
+        pdf0 = None
+        for c in in_cols:
+            width = 1
+            attrs = df._ml_attrs.get(c)
+            if attrs is None:
+                # vector input columns occupy their own width; peek one row
+                if pdf0 is None:
+                    pdf0 = df.limit(1).toPandas()
+                v = pdf0[c].iloc[0] if len(pdf0) else None
+                if isinstance(v, Vector):
+                    width = v.size
+            elif "categorical" in attrs:
+                slots[pos] = int(attrs["categorical"])
+            pos += width
+        res._ml_attrs[out_col] = {"slots": slots, "numFeatures": pos}
+        return res
 
 
 # --------------------------------------------------------------------------
@@ -182,7 +202,13 @@ class StringIndexerModel(Model):
                 out = out[keep_mask].reset_index(drop=True)
             return out
 
-        return df._derive(fn)
+        res = df._derive(fn)
+        # column metadata the tree learners read for maxBins semantics:
+        # an indexed column is categorical with known cardinality (ML 06:91-126)
+        extra = 1 if invalid == "keep" else 0
+        for oc, ls in zip(out_cols, self.labelsArray):
+            res._ml_attrs[oc] = {"categorical": len(ls) + extra}
+        return res
 
     def _extra_metadata(self):
         return {"labelsArray": self.labelsArray}

@@ -19,6 +19,9 @@ from .feature import _as_object_series
 from .linalg import DenseVector
 from ._staging import extract_features, extract_xy
 from . import linear_impl
+from ._tree_models import (DecisionTreeRegressionModel, DecisionTreeRegressor,
+                           GBTRegressionModel, GBTRegressor,
+                           RandomForestRegressionModel, RandomForestRegressor)
 
 
 class _PredictorParams:

@@ -1,0 +1,351 @@
+"""Histogram tree engine — one second-order PLANET learner for DT/RF/GBT.
+
+The reference trains trees by the PLANET recipe: discretize features into
+`maxBins` bins, have each worker build per-(node, feature, bin) statistics
+over its rows, merge "via tree reduce", pick splits centrally
+(`SML/ML 06 - Decision Trees.py:98-118`); distributed XGBoost does the same
+with gradient/hessian stats merged by Rabit allreduce (`SML/ML 11 -
+XGBoost.py:55-69`). This module is the TPU-native re-design of both:
+
+- binning on host (quantile edges; categorical slots get one bin per
+  category, ordered by label mean — the ordered-categorical trick PLANET and
+  Spark use for regression/binary targets);
+- ONE jitted shard_map program builds a whole tree: level-wise scatter-add
+  histograms of (grad, hess, weight) per chip → `psum` over ICI (the Rabit
+  allreduce), replicated split selection from cumulative bin sums, and
+  on-device node reassignment — no host round-trip per level;
+- everything is second-order (XGBoost objective): squared loss ⇒ grad=-y,
+  hess=1 reduces leaves to masked means and gain to SSE reduction, so plain
+  decision trees, random forests and boosted trees are the same compiled
+  program with different (grad, hess) streams and random masks.
+
+Static shapes throughout: node arrays are full binary trees of size
+2^(maxDepth+1)-1, rows are padded+masked, so one XLA compile per
+(depth, features, bins, shard) signature serves every tree of a forest and
+every boosting round (SURVEY §7 hard part #6).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import collectives as coll
+from ..parallel import mesh as meshlib
+from ._staging import data_parallel, stage_sharded
+
+
+class TreeSpec(NamedTuple):
+    """Static (hashable) build configuration — part of the jit cache key."""
+    max_depth: int
+    n_bins: int
+    n_features: int
+    feature_k: int          # features considered per node (RF subspace); =n_features for DT/GBT
+    min_instances: int
+    min_info_gain: float
+    reg_lambda: float       # L2 on leaf values (XGBoost lambda; 0 for plain trees)
+    gamma: float            # min split loss (XGBoost gamma)
+
+
+class FittedTree(NamedTuple):
+    split_feature: np.ndarray   # (N,) int32, -1 for leaves
+    split_bin: np.ndarray       # (N,) int32: go left iff bin <= split_bin
+    leaf_value: np.ndarray      # (N,) float32
+    gain: np.ndarray            # (N,) float32 split gains (importance source)
+    cover: np.ndarray           # (N,) float32 hessian mass per node
+
+
+class Binning(NamedTuple):
+    edges: np.ndarray           # (F, B-1) float32 upper-inclusive thresholds (+inf padded)
+    cat_remap: Dict[int, np.ndarray]  # slot -> category->rank map (label-mean order)
+
+
+def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
+              categorical: Optional[Dict[int, int]] = None,
+              max_categories_error: bool = True) -> Tuple[np.ndarray, Binning]:
+    """Host-side discretization. Continuous features: quantile edges.
+    Categorical slots: identity bins ordered by mean label; cardinality must
+    fit in max_bins, reproducing Spark's maxBins error (`ML 06:91-126`)."""
+    n, F = X.shape
+    categorical = categorical or {}
+    for slot, card in categorical.items():
+        if card > max_bins and max_categories_error:
+            raise ValueError(
+                f"DecisionTree requires maxBins (= {max_bins}) to be at least "
+                f"as large as the number of values in each categorical feature, "
+                f"but categorical feature {slot} has {card} values. "
+                f"Consider removing this and other categorical features with "
+                f"a large number of values, or add more training examples.")
+    edges = np.full((F, max_bins - 1), np.inf, dtype=np.float32)
+    binned = np.zeros((n, F), dtype=np.int32)
+    remaps: Dict[int, np.ndarray] = {}
+    for f in range(F):
+        col = X[:, f]
+        if f in categorical:
+            card = int(categorical[f])
+            means = np.full(card, np.inf)
+            ids = col.astype(np.int64)
+            ids = np.clip(ids, 0, card - 1)
+            for c in range(card):
+                sel = ids == c
+                if sel.any():
+                    means[c] = float(y[sel].mean()) if y is not None else c
+            order = np.argsort(means, kind="stable")
+            rank = np.empty(card, dtype=np.int32)
+            rank[order] = np.arange(card, dtype=np.int32)
+            remaps[f] = rank
+            binned[:, f] = rank[ids]
+            edges[f, :] = np.inf  # traversal uses bins directly
+        else:
+            finite = col[np.isfinite(col)]
+            if len(finite) == 0:
+                continue
+            qs = np.quantile(finite, np.linspace(0, 1, max_bins + 1)[1:-1])
+            qs = np.unique(qs.astype(np.float32))
+            edges[f, :len(qs)] = qs
+            binned[:, f] = np.searchsorted(qs, col, side="left").astype(np.int32)
+            binned[~np.isfinite(col), f] = 0  # missing → lowest bin
+    return binned, Binning(edges=edges, cat_remap=remaps)
+
+
+def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
+    """Apply training-time bin edges / category ranks at predict time."""
+    n, F = X.shape
+    out = np.zeros((n, F), dtype=np.int32)
+    for f in range(F):
+        if f in binning.cat_remap:
+            rank = binning.cat_remap[f]
+            ids = np.clip(X[:, f].astype(np.int64), 0, len(rank) - 1)
+            out[:, f] = rank[ids]
+        else:
+            e = binning.edges[f]
+            e = e[np.isfinite(e)]
+            out[:, f] = np.searchsorted(e, X[:, f], side="left").astype(np.int32)
+            out[~np.isfinite(X[:, f]), f] = 0
+    return out
+
+
+# ---------------------------------------------------------------------------
+def _build_tree_program(spec: TreeSpec):
+    """The per-chip tree builder run under shard_map; collectives over 'data'."""
+    D, B, F = spec.max_depth, spec.n_bins, spec.n_features
+    n_nodes = 2 ** (D + 1) - 1
+
+    def program(binned, grad, hess, weight, feat_rng):
+        # binned (n, F) int32; grad/hess/weight (n,); rng scalars uint32
+        n = binned.shape[0]
+        node = jnp.zeros((n,), dtype=jnp.int32)
+        active = weight > 0
+        split_feature = jnp.full((n_nodes,), -1, dtype=jnp.int32)
+        split_bin = jnp.zeros((n_nodes,), dtype=jnp.int32)
+        gains = jnp.zeros((n_nodes,), dtype=jnp.float32)
+        # node stats accumulated as we go (root gets totals at level 0)
+        node_G = jnp.zeros((n_nodes,), dtype=jnp.float32)
+        node_H = jnp.zeros((n_nodes,), dtype=jnp.float32)
+        node_W = jnp.zeros((n_nodes,), dtype=jnp.float32)
+
+        for level in range(D):
+            width = 2 ** level
+            base = width - 1
+            lid = node - base  # local node id at this level; valid in [0,width)
+            in_level = active & (lid >= 0) & (lid < width)
+            lid_c = jnp.where(in_level, lid, 0)
+            # --- histograms: scatter-add (n, F) entries into (width*F*B) ---
+            flat = (lid_c[:, None] * (F * B)
+                    + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+                    + binned)
+            wq = jnp.where(in_level, weight, 0.0)
+            gq = grad * wq
+            hq = hess * wq
+            hist_G = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
+                .add(jnp.broadcast_to(gq[:, None], (n, F)).ravel())
+            hist_H = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
+                .add(jnp.broadcast_to(hq[:, None], (n, F)).ravel())
+            hist_W = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
+                .add(jnp.broadcast_to(wq[:, None], (n, F)).ravel())
+            # the PLANET/Rabit merge: one ICI allreduce per level
+            hist = coll.psum(jnp.stack([hist_G, hist_H, hist_W]))
+            hG = hist[0].reshape(width, F, B)
+            hH = hist[1].reshape(width, F, B)
+            hW = hist[2].reshape(width, F, B)
+            # --- split scoring from cumulative sums ---------------------------
+            GL = jnp.cumsum(hG, axis=2)
+            HL = jnp.cumsum(hH, axis=2)
+            WL = jnp.cumsum(hW, axis=2)
+            G = GL[:, :, -1:]
+            H = HL[:, :, -1:]
+            W = WL[:, :, -1:]
+            lam = spec.reg_lambda
+            score = (GL ** 2 / (HL + lam + 1e-12)
+                     + (G - GL) ** 2 / (H - HL + lam + 1e-12)
+                     - G ** 2 / (H + lam + 1e-12))
+            ok = ((WL >= spec.min_instances)
+                  & ((W - WL) >= spec.min_instances))
+            # last bin has empty right child; never a valid split
+            ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
+            if spec.feature_k < F:
+                # RF per-node feature subspace: exactly k features per node,
+                # chosen by ranking per-(node,feature) uniforms
+                u = jax.random.uniform(
+                    jax.random.fold_in(jax.random.wrap_key_data(feat_rng), level),
+                    (width, F))
+                ranks = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+                ok = ok & (ranks < spec.feature_k)[:, :, None]
+            score = jnp.where(ok, score, -jnp.inf)
+            flat_best = jnp.argmax(score.reshape(width, F * B), axis=1)
+            best_f = (flat_best // B).astype(jnp.int32)
+            best_b = (flat_best % B).astype(jnp.int32)
+            best_gain = 0.5 * jnp.take_along_axis(
+                score.reshape(width, F * B), flat_best[:, None], axis=1)[:, 0] \
+                - spec.gamma
+            do_split = (best_gain > spec.min_info_gain) & \
+                jnp.isfinite(best_gain)
+            # record per-node stats + chosen splits
+            idx = base + jnp.arange(width)
+            node_G = node_G.at[idx].set(G[:, 0, 0])
+            node_H = node_H.at[idx].set(H[:, 0, 0])
+            node_W = node_W.at[idx].set(W[:, 0, 0])
+            split_feature = split_feature.at[idx].set(
+                jnp.where(do_split, best_f, -1))
+            split_bin = split_bin.at[idx].set(best_b)
+            gains = gains.at[idx].set(jnp.where(do_split, best_gain, 0.0))
+            # --- reassign rows --------------------------------------------
+            my_f = best_f[lid_c]
+            my_b = best_b[lid_c]
+            my_split = do_split[lid_c]
+            xbin = jnp.take_along_axis(binned, my_f[:, None], axis=1)[:, 0]
+            go_right = xbin > my_b
+            child = 2 * node + 1 + go_right.astype(jnp.int32)
+            node = jnp.where(in_level & my_split, child, node)
+            active = in_level & my_split
+
+        # leaf stats for the last level
+        width = 2 ** D
+        base = width - 1
+        lid = node - base
+        in_level = (lid >= 0) & (lid < width) & (weight > 0)
+        lid_c = jnp.where(in_level, lid, 0)
+        wq = jnp.where(in_level, weight, 0.0)
+        lG = jnp.zeros((width,), jnp.float32).at[lid_c].add(grad * wq)
+        lH = jnp.zeros((width,), jnp.float32).at[lid_c].add(hess * wq)
+        lW = jnp.zeros((width,), jnp.float32).at[lid_c].add(wq)
+        lstats = coll.psum(jnp.stack([lG, lH, lW]))
+        idx = base + jnp.arange(width)
+        node_G = node_G.at[idx].set(lstats[0])
+        node_H = node_H.at[idx].set(lstats[1])
+        node_W = node_W.at[idx].set(lstats[2])
+        leaf_value = -node_G / (node_H + spec.reg_lambda + 1e-12)
+        return split_feature, split_bin, leaf_value, gains, node_H
+
+    return program
+
+
+_tree_cache: Dict[TreeSpec, object] = {}
+
+
+def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
+             rng: int = 0, feat_key: Optional[np.ndarray] = None) -> FittedTree:
+    """Build one tree on the mesh from pre-staged device arrays."""
+    if spec not in _tree_cache:
+        _tree_cache[spec] = data_parallel(_build_tree_program(spec),
+                                          replicated_argnums=(4,))
+    compiled = _tree_cache[spec]
+    if feat_key is None:
+        feat_key = jax.random.key_data(jax.random.PRNGKey(rng))
+    sf, sb, lv, g, cov = compiled(binned_dev, grad_dev, hess_dev, weight_dev,
+                                  feat_key)
+    sf, sb, lv = np.asarray(sf).copy(), np.asarray(sb), np.asarray(lv).copy()
+    cov = np.asarray(cov)
+    # nodes never reached in training (zero cover) inherit the parent value so
+    # unseen routes at predict time fall back gracefully
+    for i in range(1, len(lv)):
+        if cov[i] == 0:
+            lv[i] = lv[(i - 1) // 2]
+            sf[i] = -1
+    return FittedTree(sf, sb, lv, np.asarray(g), cov)
+
+
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("depth",))
+def _predict_binned(binned, split_feature, split_bin, leaf_value, depth: int):
+    n = binned.shape[0]
+    node = jnp.zeros((n,), dtype=jnp.int32)
+    for _ in range(depth):
+        f = split_feature[node]
+        b = split_bin[node]
+        is_internal = f >= 0
+        xbin = jnp.take_along_axis(binned, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        child = 2 * node + 1 + (xbin > b).astype(jnp.int32)
+        node = jnp.where(is_internal, child, node)
+    return leaf_value[node]
+
+
+def predict_tree(binned: np.ndarray, tree: FittedTree, depth: int) -> np.ndarray:
+    out = _predict_binned(jnp.asarray(binned), jnp.asarray(tree.split_feature),
+                          jnp.asarray(tree.split_bin),
+                          jnp.asarray(tree.leaf_value), depth)
+    return np.asarray(out)
+
+
+def predict_forest(binned: np.ndarray, trees, depth: int,
+                   weights: Optional[np.ndarray] = None) -> np.ndarray:
+    """Sum/average of per-tree predictions, evaluated as stacked vmapped
+    traversals (one fused XLA program rather than T python loops)."""
+    sf = jnp.stack([jnp.asarray(t.split_feature) for t in trees])
+    sb = jnp.stack([jnp.asarray(t.split_bin) for t in trees])
+    lv = jnp.stack([jnp.asarray(t.leaf_value) for t in trees])
+    b = jnp.asarray(binned)
+    per_tree = jax.vmap(lambda f, s, v: _predict_binned(b, f, s, v, depth))(sf, sb, lv)
+    if weights is None:
+        return np.asarray(per_tree.mean(axis=0))
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    return np.asarray(jnp.tensordot(w, per_tree, axes=1))
+
+
+def feature_importances(trees, n_features: int) -> np.ndarray:
+    """Gain-weighted importance, normalized to sum 1 (Spark semantics:
+    per-tree normalization, then averaged over trees)."""
+    total = np.zeros(n_features, dtype=np.float64)
+    for t in trees:
+        imp = np.zeros(n_features, dtype=np.float64)
+        for node, f in enumerate(t.split_feature):
+            if f >= 0:
+                imp[int(f)] += max(float(t.gain[node]), 0.0)
+        s = imp.sum()
+        if s > 0:
+            total += imp / s
+    s = total.sum()
+    return total / s if s > 0 else total
+
+
+# ---------------------------------------------------------------------------
+class StagedData(NamedTuple):
+    binned: np.ndarray          # host copy (training-time re-prediction)
+    binned_dev: jax.Array
+    mask_dev: jax.Array
+    y: np.ndarray
+    n_true: int
+    binning: Binning
+    n_padded: int
+
+
+def stage_tree_data(X: np.ndarray, y: np.ndarray, max_bins: int,
+                    categorical: Optional[Dict[int, int]] = None) -> StagedData:
+    binned, binning = make_bins(X, y, max_bins, categorical)
+    binned_dev, mask_dev, n_true = stage_sharded(binned)
+    return StagedData(binned=binned, binned_dev=binned_dev, mask_dev=mask_dev,
+                      y=y, n_true=n_true, binning=binning,
+                      n_padded=binned_dev.shape[0])
+
+
+def stage_aligned(arr: np.ndarray, n_padded: int):
+    """Shard a per-row array aligned with previously staged binned data."""
+    mesh = meshlib.get_mesh()
+    padded = np.zeros((n_padded,) + arr.shape[1:], dtype=np.float32)
+    padded[:arr.shape[0]] = arr
+    return jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))

@@ -1,0 +1,110 @@
+"""XGBoost-equivalent estimators with the `sparkdl.xgboost` surface.
+
+The reference trains `XgboostRegressor(n_estimators=…, learning_rate=…,
+max_depth=…, random_state=…, missing=0, num_workers=…, use_gpu=…)` inside an
+MLlib Pipeline (`SML/ML 11 - XGBoost.py:55-72`). There the gradient/histogram
+aggregation is Rabit allreduce in C++; here the SAME second-order histogram
+boosting runs as the jitted mesh program in `sml_tpu.ml.tree_impl`, whose
+per-level reduction is one psum over ICI — `tpu_hist`, the `gpu_hist`
+equivalent named in SURVEY §2.2 P9. `num_workers` maps to mesh data-shards;
+`use_gpu`/`device` is accepted for surface parity ('tpu' is the only engine).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ml._tree_models import (_EnsembleSpec, _TreeClassificationModel,
+                              _TreeEstimatorBase, _TreeRegressionModel,
+                              _categorical_slots, _fit_ensemble)
+
+
+class _XgboostParams:
+    def _declare_xgb_params(self):
+        self._declareParam("featuresCol", default="features", doc="features column")
+        self._declareParam("labelCol", default="label", doc="label column")
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+        self._declareParam("n_estimators", default=100, doc="boosting rounds")
+        self._declareParam("learning_rate", default=0.3, doc="eta")
+        self._declareParam("max_depth", default=6, doc="tree depth")
+        self._declareParam("max_bins", default=256, doc="histogram bins")
+        self._declareParam("reg_lambda", default=1.0, doc="L2 on leaf weights")
+        self._declareParam("gamma", default=0.0, doc="min split loss")
+        self._declareParam("subsample", default=1.0, doc="row subsample per round")
+        self._declareParam("min_child_weight", default=1.0, doc="min hessian per child")
+        self._declareParam("random_state", default=0, doc="seed")
+        self._declareParam("missing", default=float("nan"), doc="value treated as missing")
+        self._declareParam("num_workers", default=None,
+                           doc="data shards (defaults to mesh size)")
+        self._declareParam("use_gpu", default=False, doc="accepted for surface parity")
+        self._declareParam("device", default="tpu", doc="compute engine")
+        self._declareParam("tree_method", default="tpu_hist", doc="histogram engine")
+
+
+class _XgboostBase(_TreeEstimatorBase, _XgboostParams):
+    _loss = "squared"
+    _model_cls = None
+
+    def _init_params(self):
+        self._declare_xgb_params()
+
+    def __init__(self, **kwargs):
+        super(_TreeEstimatorBase, self).__init__()
+        for k, v in kwargs.items():
+            if self.hasParam(k):
+                self._set(**{k: v})
+            else:
+                raise TypeError(f"unexpected param {k!r}")
+
+    def _fit(self, df):
+        pdf = df.toPandas()
+        from .ml._staging import extract_xy
+        import numpy as np
+        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+                             self.getOrDefault("labelCol"))
+        ok = np.isfinite(y)
+        X, y = X[ok], y[ok]
+        cat = _categorical_slots(df, self.getOrDefault("featuresCol"))
+        spec = _fit_ensemble(
+            X, y, categorical=cat,
+            max_depth=int(self.getOrDefault("max_depth")),
+            max_bins=int(self.getOrDefault("max_bins")),
+            min_instances=int(self.getOrDefault("min_child_weight")),
+            min_info_gain=0.0,
+            n_trees=int(self.getOrDefault("n_estimators")), feature_k=None,
+            bootstrap=False, subsample=float(self.getOrDefault("subsample")),
+            seed=int(self.getOrDefault("random_state")), loss=self._loss,
+            step_size=float(self.getOrDefault("learning_rate")),
+            reg_lambda=float(self.getOrDefault("reg_lambda")),
+            gamma=float(self.getOrDefault("gamma")), boosting=True,
+            missing=float(self.getOrDefault("missing")))
+        m = self._model_cls(spec)
+        m._inherit_params(self)
+        return m
+
+
+class XgboostRegressorModel(_TreeRegressionModel, _XgboostParams):
+    def _init_params(self):
+        self._declare_xgb_params()
+
+
+class XgboostRegressor(_XgboostBase):
+    _loss = "squared"
+    _model_cls = XgboostRegressorModel
+
+
+class XgboostClassifierModel(_TreeClassificationModel, _XgboostParams):
+    def _init_params(self):
+        self._declare_xgb_params()
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="raw scores")
+        self._declareParam("probabilityCol", default="probability", doc="probabilities")
+
+
+class XgboostClassifier(_XgboostBase):
+    _loss = "logistic"
+    _model_cls = XgboostClassifierModel
+
+    def _init_params(self):
+        self._declare_xgb_params()
+        self._declareParam("rawPredictionCol", default="rawPrediction", doc="raw scores")
+        self._declareParam("probabilityCol", default="probability", doc="probabilities")

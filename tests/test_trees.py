@@ -1,0 +1,153 @@
+"""Tree learner tests: the ML 06 / ML 07 / ML 11 behaviors.
+
+Reference anchors reproduced here: the maxBins-vs-cardinality error and its
+setMaxBins fix (`ML 06:91-126`), featureImportances (`ML 06:141-154`),
+RF beating a single DT (`ML 07:171`), and the XGBoost surface of `ML 11`.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu.ml import Pipeline
+from sml_tpu.ml.evaluation import (BinaryClassificationEvaluator,
+                                   RegressionEvaluator)
+from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+from sml_tpu.ml.regression import (DecisionTreeRegressor, GBTRegressor,
+                                   RandomForestRegressor)
+from sml_tpu.ml.classification import RandomForestClassifier
+from sml_tpu.xgboost import XgboostRegressor
+
+
+def _friedman(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 5))
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4] + rng.normal(0, 1, n))
+    cols = {f"f{i}": X[:, i] for i in range(5)}
+    cols["label"] = y
+    return pd.DataFrame(cols)
+
+
+@pytest.fixture()
+def friedman_df(spark):
+    return spark.createDataFrame(_friedman())
+
+
+def _assembled(df):
+    va = VectorAssembler(inputCols=[f"f{i}" for i in range(5)],
+                         outputCol="features")
+    return va.transform(df)
+
+
+def test_decision_tree_beats_mean(friedman_df):
+    train, test = friedman_df.randomSplit([0.8, 0.2], seed=42)
+    dt = DecisionTreeRegressor(maxDepth=6)
+    model = dt.fit(_assembled(train))
+    pred = model.transform(_assembled(test))
+    rmse = RegressionEvaluator().evaluate(pred)
+    base = float(np.std(test.toPandas()["label"]))
+    assert rmse < base * 0.6
+
+
+def test_decision_tree_feature_importances(friedman_df):
+    dt = DecisionTreeRegressor(maxDepth=6)
+    model = dt.fit(_assembled(friedman_df))
+    imp = model.featureImportances.toArray()
+    assert imp.sum() == pytest.approx(1.0, abs=1e-6)
+    assert imp[3] > 0.05  # f3 is strongly predictive
+    assert model.toDebugString
+
+
+def test_max_bins_categorical_error(spark):
+    # high-cardinality indexed categorical must error with default maxBins,
+    # and succeed after setMaxBins — the ML 06:91-126 behavior
+    rng = np.random.default_rng(3)
+    n = 400
+    cats = [f"c{i}" for i in range(36)]  # cardinality 36 > 32
+    pdf = pd.DataFrame({"cat": rng.choice(cats, n),
+                        "x": rng.random(n),
+                        "label": rng.random(n)})
+    df = spark.createDataFrame(pdf)
+    pipe_df = VectorAssembler(inputCols=["cat_idx", "x"], outputCol="features") \
+        .transform(StringIndexer(inputCol="cat", outputCol="cat_idx")
+                   .fit(df).transform(df))
+    dt = DecisionTreeRegressor()
+    with pytest.raises(ValueError, match="maxBins"):
+        dt.fit(pipe_df)
+    dt.setMaxBins(40)
+    model = dt.fit(pipe_df)  # no error
+    assert model.numFeatures == 2
+
+
+def test_random_forest_beats_single_tree(friedman_df):
+    # deep single trees overfit; bagged + feature-subspaced forests don't —
+    # the ML 07:171 "RF beats DT" anchor
+    train, test = friedman_df.randomSplit([0.8, 0.2], seed=42)
+    ev = RegressionEvaluator()
+    dt_rmse = ev.evaluate(DecisionTreeRegressor(maxDepth=8)
+                          .fit(_assembled(train)).transform(_assembled(test)))
+    rf_rmse = ev.evaluate(
+        RandomForestRegressor(maxDepth=8, numTrees=30, seed=42)
+        .fit(_assembled(train)).transform(_assembled(test)))
+    assert rf_rmse < dt_rmse
+
+
+def test_gbt_beats_random_forest(friedman_df):
+    train, test = friedman_df.randomSplit([0.8, 0.2], seed=42)
+    ev = RegressionEvaluator()
+    gbt_rmse = ev.evaluate(
+        GBTRegressor(maxDepth=5, maxIter=40, stepSize=0.2, seed=42)
+        .fit(_assembled(train)).transform(_assembled(test)))
+    base = float(np.std(test.toPandas()["label"]))
+    assert gbt_rmse < base * 0.35
+
+
+def test_rf_classifier_auroc(spark):
+    rng = np.random.default_rng(11)
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = ((X[:, 0] + X[:, 1] ** 2 + rng.normal(0, 0.3, n)) > 1.0).astype(float)
+    pdf = pd.DataFrame({f"f{i}": X[:, i] for i in range(4)})
+    pdf["label"] = y
+    df = spark.createDataFrame(pdf)
+    va = VectorAssembler(inputCols=[f"f{i}" for i in range(4)], outputCol="features")
+    train, test = df.randomSplit([0.8, 0.2], seed=42)
+    m = RandomForestClassifier(numTrees=25, maxDepth=5, seed=42).fit(va.transform(train))
+    pred = m.transform(va.transform(test))
+    auc = BinaryClassificationEvaluator().evaluate(pred)
+    assert auc > 0.85
+
+
+def test_tree_model_persistence(friedman_df, tmp_path):
+    train, test = friedman_df.randomSplit([0.8, 0.2], seed=42)
+    pipeline = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"f{i}" for i in range(5)], outputCol="features"),
+        RandomForestRegressor(maxDepth=4, numTrees=10, seed=7)])
+    model = pipeline.fit(train)
+    pred1 = model.transform(test).toPandas()["prediction"].values
+    path = str(tmp_path / "rf_pipe")
+    model.write().overwrite().save(path)
+    from sml_tpu.ml import PipelineModel
+    loaded = PipelineModel.load(path)
+    pred2 = loaded.transform(test).toPandas()["prediction"].values
+    assert np.allclose(pred1, pred2)
+    assert loaded.stages[-1].getNumTrees() == 10
+
+
+def test_xgboost_regressor_in_pipeline(friedman_df):
+    # the ML 11 shape: log-transform + XgboostRegressor inside a Pipeline
+    train, test = friedman_df.randomSplit([0.8, 0.2], seed=42)
+    params = {"n_estimators": 40, "learning_rate": 0.2, "max_depth": 4,
+              "random_state": 42, "missing": 0.0}
+    xgb = XgboostRegressor(**params)
+    pipeline = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"f{i}" for i in range(5)], outputCol="features"),
+        xgb])
+    model = pipeline.fit(train)
+    pred = model.transform(test)
+    rmse = RegressionEvaluator().evaluate(pred)
+    base = float(np.std(test.toPandas()["label"]))
+    assert rmse < base * 0.4
+    r2 = RegressionEvaluator(metricName="r2").evaluate(pred)
+    assert r2 > 0.8
