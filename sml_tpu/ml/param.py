@@ -139,7 +139,11 @@ class Params:
         if extra:
             for p, v in extra.items():
                 if isinstance(p, Param):
-                    that._paramMap[that.getParam(p.name)] = v
+                    # params addressed to another object (e.g. a pipeline
+                    # stage) are skipped here; composite estimators like
+                    # Pipeline route them to their children in their copy()
+                    if p.parent == that.uid and that.hasParam(p.name):
+                        that._paramMap[that.getParam(p.name)] = v
                 else:
                     that._paramMap[that.getParam(p)] = v
         return that

@@ -1,0 +1,211 @@
+"""Model selection: grid search CV and train/validation split.
+
+Reference surface (`SML/ML 07 - Random Forests and Hyperparameter
+Tuning.py:72-158`): `ParamGridBuilder().addGrid(...).build()`,
+`CrossValidator(estimator, evaluator, estimatorParamMaps, numFolds=3,
+parallelism=4, seed=42)` with `avgMetrics`/`bestModel`, and both stage
+orders (CV-inside-pipeline vs pipeline-inside-CV, `ML 07:134-149`).
+
+Parallelism: trials dispatch on a thread pool of width `parallelism`
+(the reference's driver thread pool, `ML 07:120-130`); each trial's device
+programs are serialized by XLA per-chip, so threads overlap host-side work
+(staging, binning, metric assembly) with device compute — the task-parallel
+model-selection strategy SURVEY §2.2 P6.
+"""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .base import Estimator, Model, Saveable
+from .param import Param
+
+
+class ParamGridBuilder:
+    def __init__(self):
+        self._grid: Dict[Param, List[Any]] = {}
+
+    def addGrid(self, param: Param, values) -> "ParamGridBuilder":
+        self._grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        for m in args:
+            for p, v in (m.items() if isinstance(m, dict) else [m]):
+                self._grid[p] = [v]
+        return self
+
+    def build(self) -> List[Dict[Param, Any]]:
+        keys = list(self._grid.keys())
+        out = []
+        for combo in itertools.product(*[self._grid[k] for k in keys]):
+            out.append(dict(zip(keys, combo)))
+        return out or [{}]
+
+
+class _ValidatorParams:
+    def _declare_validator_params(self):
+        self._declareParam("estimator", doc="estimator to tune")
+        self._declareParam("estimatorParamMaps", doc="param grid")
+        self._declareParam("evaluator", doc="metric evaluator")
+        self._declareParam("seed", default=None, doc="fold assignment seed")
+        self._declareParam("parallelism", default=1, doc="concurrent trials")
+        self._declareParam("collectSubModels", default=False, doc="keep sub-models")
+
+
+def _fit_and_eval(est: Estimator, pmap, train, val, evaluator) -> float:
+    model = est.copy(pmap).fit(train)
+    return evaluator.evaluate(model.transform(val))
+
+
+class CrossValidator(Estimator, _ValidatorParams):
+    def _init_params(self):
+        self._declare_validator_params()
+        self._declareParam("numFolds", default=3, doc="number of folds")
+
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 numFolds=None, seed=None, parallelism=None, collectSubModels=None):
+        super().__init__()
+        self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
+                  evaluator=evaluator, numFolds=numFolds, seed=seed,
+                  parallelism=parallelism, collectSubModels=collectSubModels)
+
+    def _fit(self, df) -> "CrossValidatorModel":
+        est = self.getOrDefault("estimator")
+        grid = self.getOrDefault("estimatorParamMaps")
+        evaluator = self.getOrDefault("evaluator")
+        k = int(self.getOrDefault("numFolds"))
+        seed = self.getOrDefault("seed")
+        seed = int(seed) if seed is not None else 42
+        par = max(1, int(self.getOrDefault("parallelism")))
+
+        # seeded per-partition fold assignment — same contract class as
+        # randomSplit (`ML 02:38-52`): deterministic given (seed, layout)
+        folds = df.randomSplit([1.0 / k] * k, seed=seed)
+        for f in folds:
+            f.cache()
+
+        metrics = np.zeros((len(grid), k), dtype=np.float64)
+        jobs = []
+        for fi in range(k):
+            val = folds[fi]
+            rest = [folds[j] for j in range(k) if j != fi]
+            train = rest[0]
+            for r in rest[1:]:
+                train = train.union(r)
+            train.cache()
+            for gi, pmap in enumerate(grid):
+                jobs.append((gi, fi, train, val, pmap))
+
+        def run(job):
+            gi, fi, train, val, pmap = job
+            return gi, fi, _fit_and_eval(est, pmap, train, val, evaluator)
+
+        if par == 1:
+            results = [run(j) for j in jobs]
+        else:
+            with ThreadPoolExecutor(max_workers=par) as pool:
+                results = list(pool.map(run, jobs))
+        for gi, fi, m in results:
+            metrics[gi, fi] = m
+
+        avg = metrics.mean(axis=1)
+        best_idx = int(np.argmax(avg) if evaluator.isLargerBetter()
+                       else np.argmin(avg))
+        best_model = est.copy(grid[best_idx]).fit(df)
+        cvm = CrossValidatorModel(bestModel=best_model, avgMetrics=list(avg))
+        cvm._inherit_params(self)
+        return cvm
+
+
+class CrossValidatorModel(Model, _ValidatorParams):
+    def _init_params(self):
+        CrossValidator._init_params(self)
+
+    def __init__(self, bestModel=None, avgMetrics=None, subModels=None):
+        super().__init__()
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.subModels = subModels
+
+    def _transform(self, df):
+        return self.bestModel.transform(df)
+
+    def _extra_metadata(self):
+        return {"avgMetrics": [float(m) for m in self.avgMetrics]}
+
+    def _save_state(self, path):
+        import os
+        self.bestModel._save_to(os.path.join(path, "bestModel"))
+
+    def _load_state(self, path, meta):
+        import os
+        self.avgMetrics = meta.get("avgMetrics", [])
+        self.bestModel = Saveable.load(os.path.join(path, "bestModel"))
+
+
+class TrainValidationSplit(Estimator, _ValidatorParams):
+    def _init_params(self):
+        self._declare_validator_params()
+        self._declareParam("trainRatio", default=0.75, doc="train fraction")
+
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 trainRatio=None, seed=None, parallelism=None):
+        super().__init__()
+        self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
+                  evaluator=evaluator, trainRatio=trainRatio, seed=seed,
+                  parallelism=parallelism)
+
+    def _fit(self, df) -> "TrainValidationSplitModel":
+        est = self.getOrDefault("estimator")
+        grid = self.getOrDefault("estimatorParamMaps")
+        evaluator = self.getOrDefault("evaluator")
+        ratio = float(self.getOrDefault("trainRatio"))
+        seed = self.getOrDefault("seed")
+        seed = int(seed) if seed is not None else 42
+        par = max(1, int(self.getOrDefault("parallelism")))
+        train, val = df.randomSplit([ratio, 1 - ratio], seed=seed)
+        train.cache()
+        val.cache()
+
+        def run(pmap):
+            return _fit_and_eval(est, pmap, train, val, evaluator)
+
+        if par == 1:
+            metrics = [run(p) for p in grid]
+        else:
+            with ThreadPoolExecutor(max_workers=par) as pool:
+                metrics = list(pool.map(run, grid))
+        arr = np.asarray(metrics)
+        best_idx = int(np.argmax(arr) if evaluator.isLargerBetter()
+                       else np.argmin(arr))
+        best_model = est.copy(grid[best_idx]).fit(df)
+        m = TrainValidationSplitModel(bestModel=best_model,
+                                      validationMetrics=list(arr))
+        m._inherit_params(self)
+        return m
+
+
+class TrainValidationSplitModel(Model, _ValidatorParams):
+    def _init_params(self):
+        TrainValidationSplit._init_params(self)
+
+    def __init__(self, bestModel=None, validationMetrics=None):
+        super().__init__()
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+
+    def _transform(self, df):
+        return self.bestModel.transform(df)
+
+    def _save_state(self, path):
+        import os
+        self.bestModel._save_to(os.path.join(path, "bestModel"))
+
+    def _load_state(self, path, meta):
+        import os
+        self.bestModel = Saveable.load(os.path.join(path, "bestModel"))

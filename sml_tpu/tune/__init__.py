@@ -1,0 +1,16 @@
+"""Hyperopt-compatible Bayesian tuning (SURVEY §1 L4; §2.2 P7).
+
+Drop-in surface for the course's two hyperopt modes:
+
+    from sml_tpu.tune import fmin, hp, tpe, Trials, SparkTrials, STATUS_OK
+
+`SparkTrials` is an alias of `TpuTrials` — trials fan out over host threads
+driving the chip pool rather than Spark executors.
+"""
+
+from ._fmin import (STATUS_FAIL, STATUS_OK, SparkTrials, TpuTrials, Trials,
+                    anneal, fmin, rand, tpe)
+from ._space import hp, space_eval
+
+__all__ = ["fmin", "hp", "tpe", "rand", "anneal", "Trials", "TpuTrials",
+           "SparkTrials", "STATUS_OK", "STATUS_FAIL", "space_eval"]

@@ -1,0 +1,160 @@
+"""Tuning tests: grid CV (ML 07) and hyperopt modes 1+2 (ML 08 / 08L)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu.ml import Pipeline
+from sml_tpu.ml.evaluation import RegressionEvaluator
+from sml_tpu.ml.feature import VectorAssembler
+from sml_tpu.ml.regression import LinearRegression, RandomForestRegressor
+from sml_tpu.ml.tuning import (CrossValidator, CrossValidatorModel,
+                               ParamGridBuilder, TrainValidationSplit)
+from sml_tpu.tune import (STATUS_OK, SparkTrials, Trials, fmin, hp, rand,
+                          space_eval, tpe)
+
+
+@pytest.fixture()
+def quad_df(spark):
+    rng = np.random.default_rng(9)
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] ** 2 + rng.normal(0, 0.3, n)
+    pdf = pd.DataFrame({f"f{i}": X[:, i] for i in range(3)})
+    pdf["label"] = y
+    return spark.createDataFrame(pdf)
+
+
+def test_param_grid_builder():
+    lr = LinearRegression()
+    grid = (ParamGridBuilder()
+            .addGrid(lr.regParam, [0.0, 0.1])
+            .addGrid(lr.elasticNetParam, [0.0, 0.5, 1.0])
+            .build())
+    assert len(grid) == 6
+
+
+def test_cross_validator(quad_df):
+    va = VectorAssembler(inputCols=["f0", "f1", "f2"], outputCol="features")
+    rf = RandomForestRegressor(seed=42, numTrees=5)
+    grid = (ParamGridBuilder()
+            .addGrid(rf.maxDepth, [2, 4])
+            .addGrid(rf.numTrees, [5, 10])
+            .build())
+    ev = RegressionEvaluator()
+    cv = CrossValidator(estimator=Pipeline(stages=[va, rf]),
+                        estimatorParamMaps=grid, evaluator=ev,
+                        numFolds=3, parallelism=4, seed=42)
+    model = cv.fit(quad_df)
+    assert len(model.avgMetrics) == 4
+    assert all(np.isfinite(model.avgMetrics))
+    # deeper/larger grid should not be worse than the weakest setting
+    assert min(model.avgMetrics) == pytest.approx(sorted(model.avgMetrics)[0])
+    pred = model.transform(quad_df)
+    assert "prediction" in pred.columns
+
+
+def test_cv_pipeline_inside_cv_and_cv_inside_pipeline(quad_df):
+    # both stage orders of ML 07:134-149 must work
+    va = VectorAssembler(inputCols=["f0", "f1", "f2"], outputCol="features")
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 0.1]).build()
+    ev = RegressionEvaluator()
+    # CV inside pipeline
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev,
+                        numFolds=2, seed=42)
+    pipe_model = Pipeline(stages=[va, cv]).fit(quad_df)
+    assert isinstance(pipe_model.stages[-1], CrossValidatorModel)
+    # pipeline inside CV
+    cv2 = CrossValidator(estimator=Pipeline(stages=[va, lr]),
+                         estimatorParamMaps=grid, evaluator=ev,
+                         numFolds=2, seed=42)
+    m2 = cv2.fit(quad_df)
+    assert len(m2.avgMetrics) == 2
+
+
+def test_train_validation_split(quad_df):
+    va = VectorAssembler(inputCols=["f0", "f1", "f2"], outputCol="features")
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1.0]).build()
+    tvs = TrainValidationSplit(estimator=Pipeline(stages=[va, lr]),
+                               estimatorParamMaps=grid,
+                               evaluator=RegressionEvaluator(), seed=42)
+    m = tvs.fit(quad_df)
+    assert len(m.validationMetrics) == 2
+
+
+def test_fmin_tpe_scalar():
+    # minimum of (x-3)^2 + (y+1)^2
+    def objective(params):
+        return (params["x"] - 3) ** 2 + (params["y"] + 1) ** 2
+
+    space = {"x": hp.uniform("x", -10, 10), "y": hp.uniform("y", -10, 10)}
+    trials = Trials()
+    best = fmin(objective, space, algo=tpe, max_evals=60, trials=trials,
+                rstate=np.random.RandomState(42))
+    assert min(trials.losses()) < 3.0
+    assert len(trials) == 60
+    assert trials.best_trial["result"]["status"] == STATUS_OK
+    # TPE adapts: post-startup trials concentrate near good regions, so the
+    # mean loss of the last 20 trials must be far below the first (random) 20
+    losses = trials.losses()
+    assert np.mean(losses[-20:]) < np.mean(losses[:20]) * 0.5
+    assert best == trials.argmin
+
+
+def test_fmin_quniform_and_choice():
+    calls = []
+
+    def objective(params):
+        calls.append(params)
+        assert params["n"] == int(params["n"])  # quantized
+        assert params["kind"] in ("a", "b")     # resolved choice
+        return abs(params["n"] - 8) + (0.5 if params["kind"] == "b" else 0.0)
+
+    space = {"n": hp.quniform("n", 1, 20, 1),
+             "kind": hp.choice("kind", ["a", "b"])}
+    best = fmin(objective, space, algo=tpe, max_evals=40,
+                rstate=np.random.RandomState(0))
+    resolved = space_eval(space, best)
+    assert resolved["n"] == pytest.approx(8, abs=3)
+    assert resolved["kind"] == "a"
+
+
+def test_spark_trials_parallel_mode():
+    # mode 2: single-node objectives fanned out (Labs/ML 08L:89-107)
+    import threading
+    seen_threads = set()
+
+    def objective(params):
+        seen_threads.add(threading.get_ident())
+        return {"loss": (params["c"] - 0.3) ** 2, "status": STATUS_OK}
+
+    trials = SparkTrials(parallelism=4)
+    best = fmin(objective, {"c": hp.uniform("c", 0, 1)}, algo=tpe,
+                max_evals=20, trials=trials, rstate=np.random.RandomState(1))
+    assert len(trials) == 20
+    assert abs(best["c"] - 0.3) < 0.25
+    assert len(seen_threads) > 1  # actually ran concurrently
+
+
+def test_fmin_over_mllib_pipeline(quad_df):
+    # mode 1: the ML 08:91-170 shape — TPE over pipeline.copy({...}).fit
+    va = VectorAssembler(inputCols=["f0", "f1", "f2"], outputCol="features")
+    rf = RandomForestRegressor(seed=42)
+    pipeline = Pipeline(stages=[va, rf])
+    ev = RegressionEvaluator()
+    train, val = quad_df.randomSplit([0.8, 0.2], seed=42)
+
+    def objective(params):
+        m = pipeline.copy({rf.maxDepth: int(params["max_depth"]),
+                           rf.numTrees: int(params["num_trees"])}).fit(train)
+        return ev.evaluate(m.transform(val))
+
+    space = {"max_depth": hp.quniform("max_depth", 2, 5, 1),
+             "num_trees": hp.quniform("num_trees", 5, 15, 5)}
+    trials = Trials()
+    best = fmin(objective, space, algo=tpe, max_evals=4, trials=trials,
+                rstate=np.random.RandomState(42))
+    assert len(trials) == 4
+    assert 2 <= best["max_depth"] <= 5
