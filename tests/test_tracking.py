@@ -1,0 +1,139 @@
+"""Tracking + registry tests: the ML 04 / ML 05 / ML 05L surfaces."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu import tracking as mlflow
+from sml_tpu.ml import Pipeline, PipelineModel
+from sml_tpu.ml.evaluation import RegressionEvaluator
+from sml_tpu.ml.feature import VectorAssembler
+from sml_tpu.ml.regression import LinearRegression
+
+
+@pytest.fixture(autouse=True)
+def tracking_dir(tmp_path):
+    mlflow.set_tracking_uri(str(tmp_path / "runs"))
+    yield
+    # close any dangling runs
+    while mlflow.active_run():
+        mlflow.end_run()
+
+
+def test_run_lifecycle_params_metrics():
+    with mlflow.start_run(run_name="LR-Single-Feature") as run:
+        mlflow.log_param("label", "price")
+        mlflow.log_metric("rmse", 123.4)
+        mlflow.log_metric("rmse", 120.0)  # history keeps both, latest wins
+        run_id = run.info.run_id
+    rec = mlflow.get_run(run_id)
+    assert rec.data.params["label"] == "price"
+    assert rec.data.metrics["rmse"] == 120.0
+    assert rec.info.status == "FINISHED"
+
+
+def test_nested_runs():
+    with mlflow.start_run(run_name="parent") as parent:
+        with mlflow.start_run(run_name="child", nested=True) as child:
+            mlflow.log_metric("mse", 1.0)
+        pass
+    rec = mlflow.get_run(child.info.run_id)
+    assert rec.data.tags["mlflow.parentRunId"] == parent.info.run_id
+
+
+def test_search_runs_filter_and_order():
+    exp = mlflow.set_experiment("search-test")
+    for i, rmse in enumerate([3.0, 1.0, 2.0]):
+        with mlflow.start_run(run_name=f"r{i}"):
+            mlflow.log_param("data_version", str(i))
+            mlflow.log_metric("rmse", rmse)
+    df = mlflow.search_runs(exp.experiment_id, order_by=["metrics.rmse ASC"])
+    assert list(df["metrics.rmse"]) == [1.0, 2.0, 3.0]
+    hit = mlflow.search_runs(exp.experiment_id,
+                             filter_string="params.data_version='1'")
+    assert len(hit) == 1 and hit["metrics.rmse"].iloc[0] == 1.0
+    both = mlflow.search_runs(
+        exp.experiment_id,
+        filter_string="params.data_version='1' and metrics.rmse<2")
+    assert len(both) == 1
+
+
+def test_spark_flavor_log_and_load(airbnb_df):
+    va = VectorAssembler(inputCols=["bedrooms"], outputCol="features")
+    lr = LinearRegression(labelCol="price")
+    model = Pipeline(stages=[va, lr]).fit(airbnb_df)
+    with mlflow.start_run() as run:
+        mlflow.spark.log_model(model, "model",
+                               input_example=airbnb_df.limit(3).toPandas())
+    loaded = mlflow.spark.load_model(f"runs:/{run.info.run_id}/model")
+    assert isinstance(loaded, PipelineModel)
+    p1 = model.transform(airbnb_df).toPandas()["prediction"].values
+    p2 = loaded.transform(airbnb_df).toPandas()["prediction"].values
+    assert np.allclose(p1, p2)
+
+
+def test_sklearn_flavor_and_pyfunc():
+    from sklearn.linear_model import LinearRegression as SkLR
+    X = np.arange(20, dtype=float).reshape(-1, 1)
+    y = 2 * X[:, 0] + 1
+    sk = SkLR().fit(X, y)
+    with mlflow.start_run() as run:
+        mlflow.sklearn.log_model(sk, "model",
+                                 signature=mlflow.infer_signature(X, y))
+    py = mlflow.pyfunc.load_model(f"runs:/{run.info.run_id}/model")
+    pred = py.predict(pd.DataFrame({"x": [5.0]}))
+    assert pred[0] == pytest.approx(11.0)
+
+
+def test_registry_stage_transitions():
+    from sklearn.linear_model import Ridge
+    sk = Ridge().fit([[0.0], [1.0]], [0.0, 1.0])
+    with mlflow.start_run() as run:
+        mlflow.sklearn.log_model(sk, "model", registered_model_name="demo-model")
+    client = mlflow.MlflowClient()
+    v1 = client.get_model_version("demo-model", 1)
+    assert v1.status == "READY"
+    client.transition_model_version_stage("demo-model", 1, stage="Staging")
+    assert client.get_model_version("demo-model", 1).current_stage == "Staging"
+    # v2 + archive existing on promote
+    with mlflow.start_run() as run2:
+        mlflow.sklearn.log_model(sk, "model")
+        mlflow.register_model(f"runs:/{run2.info.run_id}/model", "demo-model")
+    client.transition_model_version_stage("demo-model", 1, stage="Production")
+    client.transition_model_version_stage("demo-model", 2, stage="Production",
+                                          archive_existing_versions=True)
+    assert client.get_model_version("demo-model", 1).current_stage == "Archived"
+    assert client.get_model_version("demo-model", 2).current_stage == "Production"
+    # load by stage URI
+    m = mlflow.pyfunc.load_model("models:/demo-model/Production")
+    assert m.predict(pd.DataFrame({"x": [1.0]})) is not None
+    # delete
+    client.delete_model_version("demo-model", 1)
+    client.delete_registered_model("demo-model")
+    with pytest.raises(ValueError):
+        client.get_registered_model("demo-model")
+
+
+def test_pyfunc_spark_udf(spark, airbnb_df):
+    from sklearn.linear_model import LinearRegression as SkLR
+    pdf = airbnb_df.toPandas()
+    sk = SkLR().fit(pdf[["bedrooms", "accommodates"]], pdf["price"])
+    with mlflow.start_run() as run:
+        mlflow.sklearn.log_model(sk, "model")
+    predict = mlflow.pyfunc.spark_udf(spark, f"runs:/{run.info.run_id}/model")
+    out = airbnb_df.withColumn(
+        "prediction", predict("bedrooms", "accommodates")).toPandas()
+    expect = sk.predict(pdf[["bedrooms", "accommodates"]])
+    assert np.allclose(out["prediction"].values, expect)
+
+
+def test_artifacts_and_client_listing(tmp_path):
+    f = tmp_path / "note.txt"
+    f.write_text("hello")
+    with mlflow.start_run() as run:
+        mlflow.log_artifact(str(f))
+        mlflow.log_text("summary", "report/summary.txt")
+    client = mlflow.MlflowClient()
+    arts = {a.path for a in client.list_artifacts(run.info.run_id)}
+    assert "note.txt" in arts
+    assert "report/summary.txt" in arts
