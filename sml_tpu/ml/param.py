@@ -49,24 +49,30 @@ class Params:
         self.uid = _gen_uid(type(self).__name__)
         self._defaultParamMap: Dict[Param, Any] = {}
         self._paramMap: Dict[Param, Any] = {}
+        self._shadowed_params: Dict[str, Param] = {}
 
     # -- declaration ------------------------------------------------------
     def _declareParam(self, name: str, default: Any = None, doc: str = "") -> Param:
         p = Param(self, name, doc)
-        setattr(self, name, p)
-        if default is not None or name in ("seed",):
-            self._defaultParamMap[p] = default
-        else:
-            self._defaultParamMap[p] = default
+        try:
+            setattr(self, name, p)
+        except AttributeError:
+            # name shadowed by a class property (e.g. ALSModel.rank);
+            # the param stays reachable via getParam/_shadowed
+            self._shadowed_params[name] = p
+        self._defaultParamMap[p] = default
         return p
 
     # -- access -----------------------------------------------------------
     @property
     def params(self) -> List[Param]:
-        return sorted((v for v in self.__dict__.values() if isinstance(v, Param)),
-                      key=lambda p: p.name)
+        found = [v for v in self.__dict__.values() if isinstance(v, Param)]
+        found += list(self._shadowed_params.values())
+        return sorted(found, key=lambda p: p.name)
 
     def getParam(self, name: str) -> Param:
+        if name in self._shadowed_params:
+            return self._shadowed_params[name]
         p = getattr(self, name, None)
         if not isinstance(p, Param):
             raise AttributeError(f"{type(self).__name__} has no param {name!r}")
@@ -80,7 +86,8 @@ class Params:
         return self._resolve(param) in self._paramMap
 
     def hasParam(self, name: str) -> bool:
-        return isinstance(getattr(self, name, None), Param)
+        return name in self._shadowed_params or \
+            isinstance(getattr(self, name, None), Param)
 
     def getOrDefault(self, param) -> Any:
         param = self._resolve(param)

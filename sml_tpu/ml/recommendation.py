@@ -1,0 +1,233 @@
+"""ALS — block-parallel alternating least squares (SURVEY §2.2 P4).
+
+The reference's `ALS(userCol, itemCol, ratingCol, rank, maxIter,
+coldStartStrategy)` trains MovieLens 1M (`SML/ML Electives/MLE 01 -
+Collaborative Filtering Lab.py:159-201`). Spark's implementation blocks
+users/items across executors and shuffles factor blocks; here each half-step
+is ONE jitted shard_map program over rating shards:
+
+    per chip:  segment-sum of (f_i ⊗ f_i, r·f_i) by user  → (U, r, r), (U, r)
+    psum       over ICI (the factor-block exchange)
+    vmapped    batched Cholesky solve of all U normal systems on-device
+
+with ALS-WR regularization (λ·n_u, Spark's scheme). Ratings stay sharded in
+HBM for the whole fit; only the (entities × rank) factor matrices replicate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..parallel import collectives as coll
+from .base import Estimator, Model, load_arrays, save_arrays
+from ._staging import data_parallel
+
+
+def _half_step_program(n_out: int, rank: int, reg: float):
+    """Solve factors for one side given the other side's factors."""
+
+    def program(ids, ratings, mask, other_factors_rows):
+        # ids: (n,) int32 target-entity id per rating (row-sharded)
+        # other_factors_rows: (n, rank) factor of the *other* entity per rating
+        f = other_factors_rows * mask[:, None]
+        outer = f[:, :, None] * other_factors_rows[:, None, :]   # (n, r, r)
+        A = jax.ops.segment_sum(outer, ids, num_segments=n_out)
+        b = jax.ops.segment_sum(f * ratings[:, None], ids, num_segments=n_out)
+        cnt = jax.ops.segment_sum(mask, ids, num_segments=n_out)
+        A = coll.psum(A)
+        b = coll.psum(b)
+        cnt = coll.psum(cnt)
+        lam = reg * jnp.maximum(cnt, 1.0)
+        A = A + lam[:, None, None] * jnp.eye(rank, dtype=A.dtype)[None]
+        sol = jnp.linalg.solve(A, b[:, :, None])[:, :, 0]
+        return jnp.where(cnt[:, None] > 0, sol, 0.0)
+
+    return program
+
+
+class ALS(Estimator):
+    def _init_params(self):
+        self._declareParam("userCol", default="user", doc="user id column")
+        self._declareParam("itemCol", default="item", doc="item id column")
+        self._declareParam("ratingCol", default="rating", doc="rating column")
+        self._declareParam("predictionCol", default="prediction", doc="prediction column")
+        self._declareParam("rank", default=10, doc="latent factor size")
+        self._declareParam("maxIter", default=10, doc="alternations")
+        self._declareParam("regParam", default=0.1, doc="ALS-WR lambda")
+        self._declareParam("coldStartStrategy", default="nan", doc="nan|drop")
+        self._declareParam("nonnegative", default=False, doc="clip factors at 0")
+        self._declareParam("implicitPrefs", default=False, doc="implicit feedback")
+        self._declareParam("seed", default=None, doc="init seed")
+
+    def __init__(self, userCol=None, itemCol=None, ratingCol=None, rank=None,
+                 maxIter=None, regParam=None, coldStartStrategy=None,
+                 nonnegative=None, implicitPrefs=None, seed=None,
+                 predictionCol=None):
+        super().__init__()
+        self._set(userCol=userCol, itemCol=itemCol, ratingCol=ratingCol,
+                  rank=rank, maxIter=maxIter, regParam=regParam,
+                  coldStartStrategy=coldStartStrategy, nonnegative=nonnegative,
+                  implicitPrefs=implicitPrefs, seed=seed,
+                  predictionCol=predictionCol)
+
+    def setColdStartStrategy(self, v):
+        return self._set(coldStartStrategy=v)
+
+    def getUserCol(self):
+        return self.getOrDefault("userCol")
+
+    def getItemCol(self):
+        return self.getOrDefault("itemCol")
+
+    def _fit(self, df) -> "ALSModel":
+        pdf = df.toPandas()
+        uc, ic, rc = (self.getOrDefault("userCol"), self.getOrDefault("itemCol"),
+                      self.getOrDefault("ratingCol"))
+        rank = int(self.getOrDefault("rank"))
+        max_iter = int(self.getOrDefault("maxIter"))
+        reg = float(self.getOrDefault("regParam"))
+        seed = self.getOrDefault("seed")
+        rng = np.random.default_rng(int(seed) if seed is not None else 0)
+
+        users_raw = np.asarray(pdf[uc])
+        items_raw = np.asarray(pdf[ic])
+        ratings = np.asarray(pdf[rc], dtype=np.float32)
+        u_ids, u_index = np.unique(users_raw, return_inverse=True)
+        i_ids, i_index = np.unique(items_raw, return_inverse=True)
+        U, I = len(u_ids), len(i_ids)
+
+        # stage rating triples sharded by row
+        from ._staging import stage_sharded
+        u_dev, i_dev, r_dev, mask, _ = stage_sharded(
+            u_index.astype(np.int32), i_index.astype(np.int32), ratings)
+
+        uf = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
+        itf = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
+
+        solve_users = data_parallel(_half_step_program(U, rank, reg))
+        solve_items = data_parallel(_half_step_program(I, rank, reg))
+
+        @jax.jit
+        def gather(factors, idx):
+            return factors[idx]
+
+        nonneg = bool(self.getOrDefault("nonnegative"))
+        for _ in range(max_iter):
+            uf = solve_users(u_dev, r_dev, mask, gather(itf, i_dev))
+            if nonneg:
+                uf = jnp.maximum(uf, 0.0)
+            itf = solve_items(i_dev, r_dev, mask, gather(uf, u_dev))
+            if nonneg:
+                itf = jnp.maximum(itf, 0.0)
+
+        m = ALSModel(user_ids=u_ids, item_ids=i_ids,
+                     user_factors=np.asarray(uf), item_factors=np.asarray(itf))
+        m._inherit_params(self)
+        return m
+
+
+class ALSModel(Model):
+    def _init_params(self):
+        ALS._init_params(self)
+
+    def __init__(self, user_ids=None, item_ids=None, user_factors=None,
+                 item_factors=None):
+        super().__init__()
+        self._user_ids = user_ids
+        self._item_ids = item_ids
+        self._uf = user_factors
+        self._if = item_factors
+
+    def setColdStartStrategy(self, v):
+        return self._set(coldStartStrategy=v)
+
+    @property
+    def rank(self) -> int:
+        return int(self._uf.shape[1])
+
+    @property
+    def userFactors(self):
+        from ..frame.session import get_session
+        return get_session().createDataFrame(pd.DataFrame(
+            {"id": self._user_ids, "features": list(map(list, self._uf))}))
+
+    @property
+    def itemFactors(self):
+        from ..frame.session import get_session
+        return get_session().createDataFrame(pd.DataFrame(
+            {"id": self._item_ids, "features": list(map(list, self._if))}))
+
+    def _lookup(self, raw, ids, factors):
+        idx = np.searchsorted(ids, raw)
+        idx = np.clip(idx, 0, len(ids) - 1)
+        known = ids[idx] == raw
+        return idx, known
+
+    def _transform(self, df):
+        uc, ic = self.getOrDefault("userCol"), self.getOrDefault("itemCol")
+        oc = self.getOrDefault("predictionCol")
+        cold = self.getOrDefault("coldStartStrategy")
+
+        def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
+            out = pdf.copy()
+            if len(out) == 0:
+                out[oc] = pd.Series(dtype=float)
+                return out
+            ui, u_ok = self._lookup(np.asarray(out[uc]), self._user_ids, self._uf)
+            ii, i_ok = self._lookup(np.asarray(out[ic]), self._item_ids, self._if)
+            pred = np.einsum("ij,ij->i", self._uf[ui], self._if[ii])
+            pred = np.where(u_ok & i_ok, pred, np.nan)
+            out[oc] = pred.astype(np.float64)
+            if cold == "drop":
+                out = out[np.isfinite(out[oc])].reset_index(drop=True)
+            return out
+
+        return df._derive(fn)
+
+    def _recommend(self, ids, factors, other_ids, other_factors, n: int,
+                   id_col: str, rec_col: str):
+        scores = factors @ other_factors.T                      # MXU matmul
+        top = np.argsort(-scores, axis=1)[:, :n]
+        rows = []
+        for i, ident in enumerate(ids):
+            recs = [
+                {"id": int(other_ids[j]) if np.issubdtype(type(other_ids[j]), np.integer)
+                 else other_ids[j], "rating": float(scores[i, j])}
+                for j in top[i]]
+            rows.append({id_col: ident, "recommendations": recs})
+        from ..frame.session import get_session
+        return get_session().createDataFrame(pd.DataFrame(rows))
+
+    def recommendForAllUsers(self, numItems: int):
+        return self._recommend(self._user_ids, self._uf, self._item_ids,
+                               self._if, numItems,
+                               self.getOrDefault("userCol"), "rec")
+
+    def recommendForAllItems(self, numUsers: int):
+        return self._recommend(self._item_ids, self._if, self._user_ids,
+                               self._uf, numUsers,
+                               self.getOrDefault("itemCol"), "rec")
+
+    def recommendForUserSubset(self, dataset, numItems: int):
+        uc = self.getOrDefault("userCol")
+        want = np.unique(np.asarray(dataset.toPandas()[uc]))
+        sel = np.isin(self._user_ids, want)
+        return self._recommend(self._user_ids[sel], self._uf[sel],
+                               self._item_ids, self._if, numItems, uc, "rec")
+
+    def _save_state(self, path):
+        save_arrays(path, user_ids=self._user_ids, item_ids=self._item_ids,
+                    user_factors=self._uf, item_factors=self._if)
+
+    def _load_state(self, path, meta):
+        d = load_arrays(path)
+        self._user_ids = d["user_ids"]
+        self._item_ids = d["item_ids"]
+        self._uf = d["user_factors"]
+        self._if = d["item_factors"]

@@ -1,0 +1,107 @@
+"""KMeans (MLE 02) and ALS (MLE 01) behaviors on the CPU mesh."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu.ml.clustering import KMeans, KMeansModel
+from sml_tpu.ml.evaluation import RegressionEvaluator
+from sml_tpu.ml.feature import VectorAssembler
+from sml_tpu.ml.recommendation import ALS
+
+
+@pytest.fixture()
+def blobs_df(spark):
+    rng = np.random.default_rng(221)
+    centers = np.array([[0.0, 0.0], [5.0, 5.0], [0.0, 6.0]])
+    X = np.concatenate([c + rng.normal(0, 0.4, (200, 2)) for c in centers])
+    return spark.createDataFrame(pd.DataFrame({"x": X[:, 0], "y": X[:, 1]}))
+
+
+def test_kmeans_recovers_blobs(blobs_df):
+    va = VectorAssembler(inputCols=["x", "y"], outputCol="features")
+    km = KMeans(k=3, seed=221, maxIter=20)
+    model = km.fit(va.transform(blobs_df))
+    centers = np.stack(model.clusterCenters())
+    assert centers.shape == (3, 2)
+    # each true center has a learned center within 0.3
+    true = np.array([[0, 0], [5, 5], [0, 6]], dtype=float)
+    for t in true:
+        assert np.min(np.linalg.norm(centers - t, axis=1)) < 0.3
+    pred = model.transform(va.transform(blobs_df)).toPandas()
+    assert pred["prediction"].nunique() == 3
+    # maxIter sweep: more iterations can't increase training cost (MLE 02's
+    # maxIter experiment)
+    costs = [KMeans(k=3, seed=221, maxIter=i).fit(va.transform(blobs_df))
+             .summary.trainingCost for i in (1, 5, 20)]
+    assert costs[2] <= costs[0] + 1e-3
+
+
+def test_kmeans_persistence(blobs_df, tmp_path):
+    va = VectorAssembler(inputCols=["x", "y"], outputCol="features")
+    model = KMeans(k=3, seed=1).fit(va.transform(blobs_df))
+    p = str(tmp_path / "km")
+    model.write().overwrite().save(p)
+    loaded = KMeansModel.load(p)
+    assert np.allclose(np.stack(loaded.clusterCenters()),
+                       np.stack(model.clusterCenters()))
+
+
+def _ratings(n_users=60, n_items=40, rank=3, seed=0, frac=0.4):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(0, 1, (n_users, rank))
+    V = rng.normal(0, 1, (n_items, rank))
+    full = U @ V.T + 3.0
+    mask = rng.random((n_users, n_items)) < frac
+    u, i = np.nonzero(mask)
+    return pd.DataFrame({"userId": u.astype(np.int64),
+                         "movieId": i.astype(np.int64),
+                         "rating": full[u, i].astype(np.float64)})
+
+
+def test_als_fits_low_rank(spark):
+    pdf = _ratings()
+    df = spark.createDataFrame(pdf)
+    train, test = df.randomSplit([0.8, 0.2], seed=42)
+    als = ALS(userCol="userId", itemCol="movieId", ratingCol="rating",
+              rank=4, maxIter=10, regParam=0.05, seed=42,
+              coldStartStrategy="drop")
+    model = als.fit(train)
+    assert model.rank == 4
+    pred = model.transform(test)
+    rmse = RegressionEvaluator(labelCol="rating").evaluate(pred)
+    # baseline: predict the global mean rating (the MLE 01 baseline pattern)
+    tr = train.toPandas()
+    te = pred.toPandas()
+    base = float(np.sqrt(np.mean((te["rating"] - tr["rating"].mean()) ** 2)))
+    assert rmse < base * 0.7
+
+
+def test_als_cold_start(spark):
+    pdf = _ratings(n_users=20, n_items=15)
+    df = spark.createDataFrame(pdf)
+    als = ALS(userCol="userId", itemCol="movieId", ratingCol="rating",
+              rank=3, maxIter=5, seed=1)
+    model = als.fit(df)
+    unseen = spark.createDataFrame(pd.DataFrame(
+        {"userId": [9999], "movieId": [0], "rating": [3.0]}))
+    out = model.setColdStartStrategy("nan").transform(unseen).toPandas()
+    assert np.isnan(out["prediction"].iloc[0])
+    out2 = model.copy({model.getParam("coldStartStrategy"): "drop"}) \
+        .transform(unseen)
+    assert out2.count() == 0
+
+
+def test_als_recommendations(spark):
+    pdf = _ratings(n_users=25, n_items=30)
+    model = ALS(userCol="userId", itemCol="movieId", ratingCol="rating",
+                rank=4, maxIter=8, seed=3).fit(spark.createDataFrame(pdf))
+    recs = model.recommendForAllUsers(5).toPandas()
+    assert len(recs) == 25
+    first = recs["recommendations"].iloc[0]
+    assert len(first) == 5
+    # scores sorted descending
+    scores = [r["rating"] for r in first]
+    assert scores == sorted(scores, reverse=True)
+    assert model.userFactors.count() == 25
+    assert model.itemFactors.count() == 30
