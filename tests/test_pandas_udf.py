@@ -1,0 +1,98 @@
+"""Pandas function API tests: the ML 12 / ML 13 surfaces."""
+
+from typing import Iterator
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu.frame.functions import col, pandas_udf, udf
+
+
+def test_scalar_pandas_udf(airbnb_df):
+    @pandas_udf("double")
+    def double_price(p: pd.Series) -> pd.Series:
+        return p * 2.0
+
+    out = airbnb_df.withColumn("p2", double_price(col("price"))).toPandas()
+    assert np.allclose(out["p2"], out["price"] * 2)
+
+
+def test_scalar_udf_multi_column(airbnb_df):
+    @pandas_udf("double")
+    def total_beds(bed: pd.Series, acc: pd.Series) -> pd.Series:
+        return bed + acc
+
+    out = airbnb_df.withColumn("t", total_beds("bedrooms", "accommodates")).toPandas()
+    assert np.allclose(out["t"], out["bedrooms"] + out["accommodates"])
+
+
+def test_iterator_pandas_udf_loads_once(airbnb_df):
+    loads = []
+
+    @pandas_udf("double")
+    def predict(iterator: Iterator[pd.Series]) -> Iterator[pd.Series]:
+        loads.append(1)  # "model load" once per partition (ML 12:101-112)
+        for batch in iterator:
+            yield batch * 0.5
+
+    from sml_tpu.conf import GLOBAL_CONF
+    old = GLOBAL_CONF.get("sml.arrow.maxRecordsPerBatch")
+    GLOBAL_CONF.set("sml.arrow.maxRecordsPerBatch", 100)
+    try:
+        out = airbnb_df.withColumn("h", predict(col("price"))).toPandas()
+    finally:
+        GLOBAL_CONF.set("sml.arrow.maxRecordsPerBatch", old)
+    assert np.allclose(out["h"], out["price"] * 0.5)
+    n_parts = airbnb_df.rdd.getNumPartitions()
+    # called once per partition, each iterating multiple 100-row batches
+    assert len(loads) == n_parts
+
+
+def test_map_in_pandas(airbnb_df):
+    def scale(iterator):
+        for pdf in iterator:
+            pdf = pdf.copy()
+            pdf["price"] = pdf["price"] / 10
+            yield pdf[["id", "price"]]
+
+    out = airbnb_df.mapInPandas(scale, "id bigint, price double")
+    pdf = out.toPandas()
+    assert list(pdf.columns) == ["id", "price"]
+    assert len(pdf) == airbnb_df.count()
+
+
+def test_apply_in_pandas_training(spark):
+    # the ML 13 shape: per-device sklearn training fan-out
+    rng = np.random.default_rng(0)
+    n = 5000
+    pdf = pd.DataFrame({
+        "device_id": rng.integers(0, 10, n),
+        "feature": rng.random(n),
+    })
+    pdf["label"] = pdf["feature"] * (pdf["device_id"] + 1) + rng.normal(0, 0.01, n)
+    df = spark.createDataFrame(pdf)
+
+    def train_model(g: pd.DataFrame) -> pd.DataFrame:
+        from sklearn.linear_model import LinearRegression
+        m = LinearRegression().fit(g[["feature"]], g["label"])
+        return pd.DataFrame({"device_id": [g["device_id"].iloc[0]],
+                             "n_used": [len(g)],
+                             "coef": [float(m.coef_[0])]})
+
+    out = df.groupby("device_id").applyInPandas(
+        train_model, "device_id bigint, n_used bigint, coef double").toPandas()
+    assert len(out) == 10
+    out = out.sort_values("device_id").reset_index(drop=True)
+    # per-group slope ≈ device_id + 1
+    assert np.allclose(out["coef"], out["device_id"] + 1, atol=0.05)
+    assert out["n_used"].sum() == n
+
+
+def test_row_udf(airbnb_df):
+    @udf
+    def room_upper(rt):
+        return rt.upper()
+
+    out = airbnb_df.withColumn("ru", room_upper(col("room_type"))).limit(5).toPandas()
+    assert all(s == s.upper() for s in out["ru"])
