@@ -1,0 +1,98 @@
+"""Feature store (ML 10) + AutoML (ML 09) end-to-end tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu import tracking as mlflow
+from sml_tpu.feature_store import (FeatureLookup, FeatureStoreClient,
+                                   feature_table)
+from sml_tpu.ml import Pipeline
+from sml_tpu.ml.feature import VectorAssembler
+from sml_tpu.ml.regression import LinearRegression
+
+
+@pytest.fixture(autouse=True)
+def iso_dirs(tmp_path, monkeypatch):
+    mlflow.set_tracking_uri(str(tmp_path / "runs"))
+    monkeypatch.setenv("SML_FEATURE_STORE_DIR", str(tmp_path / "fs"))
+    yield
+    while mlflow.active_run():
+        mlflow.end_run()
+
+
+def test_feature_table_lifecycle(spark, airbnb_pdf):
+    fs = FeatureStoreClient()
+
+    @feature_table
+    def compute_features(df):
+        return df.select("id", "bedrooms", "accommodates")
+
+    df = spark.createDataFrame(airbnb_pdf)
+    feats = compute_features(df)
+    ft = fs.create_feature_table("airbnb_features", keys=["id"],
+                                 features_df=feats,
+                                 description="base features")
+    assert ft.name == "airbnb_features"
+    back = fs.read_table("airbnb_features").toPandas()
+    assert len(back) == len(airbnb_pdf)
+    assert set(back.columns) == {"id", "bedrooms", "accommodates"}
+
+    # merge upsert: update a subset + add a column
+    upd = spark.createDataFrame(pd.DataFrame(
+        {"id": [0, 1], "bedrooms": [9.0, 9.0], "accommodates": [9.0, 9.0],
+         "new_feat": [1.0, 2.0]}))
+    fs.write_table("airbnb_features", upd, mode="merge")
+    merged = fs.read_table("airbnb_features").toPandas()
+    assert len(merged) == len(airbnb_pdf)
+    assert merged.set_index("id").loc[0, "bedrooms"] == 9.0
+    assert "new_feat" in merged.columns
+    meta = fs.get_table("airbnb_features")
+    assert meta.primary_keys == ["id"]
+
+
+def test_training_set_log_and_score_batch(spark, airbnb_pdf):
+    fs = FeatureStoreClient()
+    df = spark.createDataFrame(airbnb_pdf)
+    fs.create_table("features_all", primary_keys=["id"],
+                    df=df.select("id", "bedrooms", "accommodates", "bathrooms"))
+    label_df = df.select("id", "price")
+    lookups = [FeatureLookup(table_name="features_all", lookup_key=["id"])]
+    ts = fs.create_training_set(label_df, lookups, label="price",
+                                exclude_columns=["id"])
+    train_df = ts.load_df()
+    assert set(train_df.columns) == {"price", "bedrooms", "accommodates",
+                                     "bathrooms"}
+    pipeline = Pipeline(stages=[
+        VectorAssembler(inputCols=["bedrooms", "accommodates", "bathrooms"],
+                        outputCol="features"),
+        LinearRegression(labelCol="price")])
+    model = pipeline.fit(train_df)
+    with mlflow.start_run() as run:
+        fs.log_model(model, "model", training_set=ts,
+                     registered_model_name="fs-model")
+    # score_batch joins features by key automatically
+    scored = fs.score_batch(f"runs:/{run.info.run_id}/model",
+                            label_df.select("id", "price"))
+    out = scored.toPandas()
+    assert "prediction" in out.columns
+    assert np.isfinite(out["prediction"]).all()
+
+
+def test_automl_regress(spark, airbnb_pdf):
+    from sml_tpu import automl
+    df = spark.createDataFrame(
+        airbnb_pdf[["bedrooms", "accommodates", "room_type", "price"]])
+    summary = automl.regress(df, target_col="price", primary_metric="rmse",
+                             timeout_minutes=5, max_trials=3)
+    assert len(summary.trials) == 3
+    best = summary.best_trial
+    assert best.mlflow_run_id
+    assert best.metrics["val_rmse"] > 0
+    # best trial's model is loadable and scores
+    model = mlflow.spark.load_model(f"runs:/{best.mlflow_run_id}/model")
+    pred = model.transform(df).toPandas()
+    assert "prediction" in pred.columns
+    # rmse better than predicting the mean
+    base = float(airbnb_pdf["price"].std())
+    assert best.metrics["val_rmse"] < base
