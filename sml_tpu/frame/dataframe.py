@@ -640,6 +640,14 @@ class DataFrame:
         self._materialize()
         return self
 
+    def to_koalas(self, index_col: Optional[str] = None):
+        """Lift into the pandas-API layer (`ML 14:134-152`)."""
+        from ..pandas_api import DataFrame as KDataFrame
+        return KDataFrame(self, index_col=index_col)
+
+    to_pandas_on_spark = to_koalas
+    pandas_api = to_koalas
+
     def __repr__(self):
         try:
             cols = ", ".join(f"{n}: {t}" for n, t in self.dtypes[:8])

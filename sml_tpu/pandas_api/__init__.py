@@ -1,0 +1,385 @@
+"""pandas API on the TPU frame engine — the Koalas layer (SURVEY §1 L8).
+
+`SML/ML 14 - Koalas.py` exercises: `read_parquet/read_delta` (`:107-110`),
+`ks.DataFrame(spark_df)` / `df.to_koalas()` / `kdf.to_spark()` (`:134-152`),
+`value_counts` (`:172`), plotting (`:180-186`), `ks.sql("… {kdf}")`
+(`:194`), the InternalFrame design (`:41-65`), default index types
+(`:114-122`) and `compute.shortcut_limit` (`:201`).
+
+Design mirrors Koalas' InternalFrame: a `_InternalFrame` pairs the immutable
+distributed frame with index metadata; pandas-style mutations create a new
+InternalFrame over derived columns (metadata-only updates), nothing executes
+until a value is actually needed. Small results (≤ shortcut_limit rows) take
+the pandas shortcut.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from ..frame.dataframe import DataFrame as SDataFrame
+from ..frame.session import get_session
+from ..frame import functions as F
+
+_options: Dict[str, Any] = {
+    "compute.shortcut_limit": 1000,
+    "compute.default_index_type": "distributed-sequence",
+    "plotting.backend": "matplotlib",
+    "display.max_rows": 1000,
+}
+
+
+def set_option(key: str, value) -> None:
+    _options[key] = value
+
+
+def get_option(key: str):
+    return _options[key]
+
+
+def reset_option(key: str) -> None:
+    defaults = {"compute.shortcut_limit": 1000,
+                "compute.default_index_type": "distributed-sequence",
+                "plotting.backend": "matplotlib", "display.max_rows": 1000}
+    _options[key] = defaults[key]
+
+
+class _InternalFrame:
+    """(distributed frame, index column) — updates swap metadata, not data."""
+
+    INDEX_COL = "__index_level_0__"
+
+    def __init__(self, sdf: SDataFrame, index_col: Optional[str] = None):
+        self.sdf = sdf
+        self.index_col = index_col
+
+    def with_index(self) -> "_InternalFrame":
+        if self.index_col is not None:
+            return self
+        # distributed-sequence default index: per-partition offsets make a
+        # global 0..n-1 sequence without a single-point shuffle (ML 14:114-122)
+        sdf = self.sdf.withColumn(self.INDEX_COL,
+                                  F.monotonically_increasing_id())
+        return _InternalFrame(sdf, self.INDEX_COL)
+
+    @property
+    def data_columns(self) -> List[str]:
+        return [c for c in self.sdf.columns if c != self.index_col]
+
+
+class Series:
+    def __init__(self, internal: _InternalFrame, column: str):
+        self._internal = internal
+        self._col = column
+
+    # -- execution --------------------------------------------------------
+    def to_pandas(self) -> pd.Series:
+        pdf = self._internal.sdf.toPandas()
+        s = pdf[self._col]
+        if self._internal.index_col and self._internal.index_col in pdf.columns:
+            s = s.set_axis(pdf[self._internal.index_col])
+        return s
+
+    toPandas = to_pandas
+
+    def head(self, n: int = 5) -> "Series":
+        return Series(_InternalFrame(self._internal.sdf.limit(n),
+                                     self._internal.index_col), self._col)
+
+    def _binop(self, other, fn) -> "Series":
+        c = F.col(self._col)
+        o = other._to_column() if isinstance(other, Series) else other
+        out_col = fn(c, o)
+        name = f"__tmp_{self._col}"
+        sdf = self._internal.sdf.withColumn(name, out_col)
+        return Series(_InternalFrame(sdf, self._internal.index_col), name)
+
+    def _to_column(self):
+        return F.col(self._col)
+
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b)
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b)
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b)
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b)
+
+    def __gt__(self, other):
+        return self._binop(other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._binop(other, lambda a, b: a >= b)
+
+    def __lt__(self, other):
+        return self._binop(other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._binop(other, lambda a, b: a <= b)
+
+    def __eq__(self, other):  # noqa: A003
+        return self._binop(other, lambda a, b: a == b)
+
+    # -- reductions -------------------------------------------------------
+    def _agg(self, fn) -> float:
+        out = self._internal.sdf.agg(fn(F.col(self._col)).alias("v")).toPandas()
+        return out["v"].iloc[0]
+
+    def mean(self):
+        return float(self._agg(F.avg))
+
+    def sum(self):  # noqa: A003
+        return float(self._agg(F.sum))
+
+    def max(self):  # noqa: A003
+        return self._agg(F.max)
+
+    def min(self):  # noqa: A003
+        return self._agg(F.min)
+
+    def count(self):
+        return int(self._agg(F.count))
+
+    def value_counts(self, normalize: bool = False, ascending: bool = False) -> pd.Series:
+        out = (self._internal.sdf.groupBy(self._col).count()
+               .orderBy("count", ascending=ascending).toPandas())
+        s = out.set_index(self._col)["count"]
+        if normalize:
+            s = s / s.sum()
+        return s
+
+    def isna(self) -> "Series":
+        return self._binop(None, lambda a, b: F.isnull(a))
+
+    isnull = isna
+
+    def fillna(self, value) -> "Series":
+        return self._binop(None, lambda a, b: F.coalesce(a, F.lit(value)))
+
+    def astype(self, dtype) -> "Series":
+        name = {float: "double", int: "bigint", str: "string"}.get(dtype, str(dtype))
+        return self._binop(None, lambda a, b: a.cast(name))
+
+    def plot(self, *a, **kw):
+        return self.to_pandas().plot(*a, **kw)
+
+    @property
+    def hist(self):
+        return self.to_pandas().hist
+
+    def __repr__(self):
+        return repr(self.to_pandas().head(int(_options["display.max_rows"])))
+
+
+class DataFrame:
+    """Koalas-style DataFrame over the distributed engine."""
+
+    def __init__(self, data=None, index_col: Optional[str] = None):
+        if isinstance(data, _InternalFrame):
+            self._internal = data
+        elif isinstance(data, SDataFrame):
+            self._internal = _InternalFrame(data, index_col)
+        elif isinstance(data, pd.DataFrame):
+            self._internal = _InternalFrame(get_session().createDataFrame(data))
+        elif isinstance(data, dict):
+            self._internal = _InternalFrame(
+                get_session().createDataFrame(pd.DataFrame(data)))
+        else:
+            raise TypeError(f"cannot build ks.DataFrame from {type(data)}")
+
+    # -- interop (ML 14:134-152) -----------------------------------------
+    def to_spark(self) -> SDataFrame:
+        return self._internal.sdf
+
+    def to_pandas(self) -> pd.DataFrame:
+        pdf = self._internal.sdf.toPandas()
+        if self._internal.index_col and self._internal.index_col in pdf.columns:
+            pdf = pdf.set_index(self._internal.index_col)
+            pdf.index.name = None
+        return pdf
+
+    toPandas = to_pandas
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def columns(self) -> pd.Index:
+        return pd.Index(self._internal.data_columns)
+
+    @property
+    def dtypes(self) -> pd.Series:
+        mapping = {"double": np.dtype("float64"), "float": np.dtype("float32"),
+                   "bigint": np.dtype("int64"), "int": np.dtype("int32"),
+                   "string": np.dtype("O"), "boolean": np.dtype("bool")}
+        return pd.Series({n: mapping.get(t, np.dtype("O"))
+                          for n, t in self._internal.sdf.dtypes
+                          if n != self._internal.index_col})
+
+    @property
+    def shape(self):
+        return (self._internal.sdf.count(), len(self.columns))
+
+    def __len__(self):
+        return self._internal.sdf.count()
+
+    # -- selection --------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return Series(self._internal, key)
+        if isinstance(key, list):
+            cols = key + ([self._internal.index_col]
+                          if self._internal.index_col else [])
+            return DataFrame(_InternalFrame(self._internal.sdf.select(*cols),
+                                            self._internal.index_col))
+        if isinstance(key, Series):  # boolean mask filter
+            name = key._col
+            sdf = key._internal.sdf.filter(F.col(name))
+            keep = [c for c in sdf.columns if not c.startswith("__tmp_")]
+            return DataFrame(_InternalFrame(sdf.select(*keep),
+                                            self._internal.index_col))
+        raise KeyError(key)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if item in self._internal.data_columns:
+            return Series(self._internal, item)
+        raise AttributeError(item)
+
+    def __setitem__(self, key: str, value):
+        if isinstance(value, Series):
+            sdf = value._internal.sdf.withColumnRenamed(value._col, key) \
+                if value._col.startswith("__tmp_") else \
+                value._internal.sdf.withColumn(key, F.col(value._col))
+            keep = [c for c in sdf.columns if not c.startswith("__tmp_")]
+            self._internal = _InternalFrame(sdf.select(*keep),
+                                            self._internal.index_col)
+        else:
+            self._internal = _InternalFrame(
+                self._internal.sdf.withColumn(key, F.lit(value)),
+                self._internal.index_col)
+
+    # -- pandas verbs -----------------------------------------------------
+    def head(self, n: int = 5) -> "DataFrame":
+        return DataFrame(_InternalFrame(self._internal.sdf.limit(n),
+                                        self._internal.index_col))
+
+    def sort_values(self, by, ascending: bool = True) -> "DataFrame":
+        by = [by] if isinstance(by, str) else list(by)
+        return DataFrame(_InternalFrame(
+            self._internal.sdf.orderBy(*by, ascending=ascending),
+            self._internal.index_col))
+
+    def drop(self, columns=None, labels=None) -> "DataFrame":
+        cols = columns or labels or []
+        cols = [cols] if isinstance(cols, str) else list(cols)
+        return DataFrame(_InternalFrame(self._internal.sdf.drop(*cols),
+                                        self._internal.index_col))
+
+    def rename(self, columns: Dict[str, str]) -> "DataFrame":
+        sdf = self._internal.sdf
+        for old, new in columns.items():
+            sdf = sdf.withColumnRenamed(old, new)
+        return DataFrame(_InternalFrame(sdf, self._internal.index_col))
+
+    def dropna(self, subset=None) -> "DataFrame":
+        return DataFrame(_InternalFrame(self._internal.sdf.dropna(subset=subset),
+                                        self._internal.index_col))
+
+    def fillna(self, value) -> "DataFrame":
+        return DataFrame(_InternalFrame(self._internal.sdf.fillna(value),
+                                        self._internal.index_col))
+
+    def describe(self) -> pd.DataFrame:
+        return self._internal.sdf.describe().toPandas().set_index("summary")
+
+    def groupby(self, by) -> "GroupBy":
+        return GroupBy(self, [by] if isinstance(by, str) else list(by))
+
+    def plot(self, *a, **kw):
+        return self.to_pandas().plot(*a, **kw)
+
+    def to_delta(self, path: str, mode: str = "overwrite") -> None:
+        self._internal.sdf.write.format("delta").mode(mode).save(path)
+
+    def to_parquet(self, path: str, mode: str = "overwrite") -> None:
+        self._internal.sdf.write.mode(mode).parquet(path)
+
+    def __repr__(self):
+        limit = int(_options["compute.shortcut_limit"])
+        return repr(self.head(limit).to_pandas())
+
+
+class GroupBy:
+    def __init__(self, kdf: DataFrame, keys: List[str]):
+        self._kdf = kdf
+        self._keys = keys
+
+    def _run(self, out) -> pd.DataFrame:
+        return out.toPandas().set_index(self._keys)
+
+    def count(self) -> pd.DataFrame:
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).count())
+
+    def mean(self) -> pd.DataFrame:
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).avg())
+
+    def sum(self) -> pd.DataFrame:  # noqa: A003
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).sum())
+
+    def max(self) -> pd.DataFrame:  # noqa: A003
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).max())
+
+    def min(self) -> pd.DataFrame:  # noqa: A003
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).min())
+
+    def agg(self, spec: Dict[str, str]) -> pd.DataFrame:
+        return self._run(self._kdf._internal.sdf.groupBy(*self._keys).agg(spec))
+
+
+# ------------------------------------------------------------------- module fns
+def from_pandas(pdf: pd.DataFrame) -> DataFrame:
+    return DataFrame(pdf)
+
+
+def read_csv(path: str, header: bool = True, **kw) -> DataFrame:
+    return DataFrame(get_session().read.csv(path, header=header,
+                                            inferSchema=True))
+
+
+def read_parquet(path: str) -> DataFrame:
+    return DataFrame(get_session().read.parquet(path))
+
+
+def read_delta(path: str, version: Optional[int] = None) -> DataFrame:
+    reader = get_session().read.format("delta")
+    if version is not None:
+        reader = reader.option("versionAsOf", version)
+    return DataFrame(reader.load(path))
+
+
+def sql(query: str, **frames) -> DataFrame:
+    """`ks.sql("SELECT * FROM {kdf} WHERE …")` — formatted frame references
+    register as temp views (ML 14:194)."""
+    import re
+    import inspect
+    caller = inspect.currentframe().f_back.f_locals
+    session = get_session()
+    for name in re.findall(r"\{(\w+)\}", query):
+        obj = frames.get(name, caller.get(name))
+        if obj is None:
+            raise ValueError(f"ks.sql: no frame named {name!r} in scope")
+        sdf = obj.to_spark() if isinstance(obj, DataFrame) else obj
+        sdf.createOrReplaceTempView(name)
+        query = query.replace("{" + name + "}", name)
+    return DataFrame(session.sql(query))
+
+
+def range(n: int) -> DataFrame:  # noqa: A001,A003
+    return DataFrame(get_session().range(n))

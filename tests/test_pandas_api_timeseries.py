@@ -1,0 +1,146 @@
+"""Koalas-layer (ML 14) and time-series (MLE 04) tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import sml_tpu.pandas_api as ks
+from sml_tpu.timeseries import (ARIMA, Holt, Prophet, SimpleExpSmoothing,
+                                acf, adfuller, pacf)
+
+
+def test_kdf_roundtrip(spark, airbnb_pdf):
+    df = spark.createDataFrame(airbnb_pdf)
+    kdf = df.to_koalas()
+    assert isinstance(kdf, ks.DataFrame)
+    sdf = kdf.to_spark()
+    assert sdf.count() == len(airbnb_pdf)
+    back = kdf.to_pandas()
+    assert set(back.columns) == set(airbnb_pdf.columns)
+
+
+def test_kdf_value_counts_and_ops(spark, airbnb_pdf):
+    kdf = ks.DataFrame(spark.createDataFrame(airbnb_pdf))
+    vc = kdf["room_type"].value_counts()
+    assert vc.sum() == len(airbnb_pdf)
+    assert vc.index[0] == airbnb_pdf["room_type"].value_counts().index[0]
+    # column arithmetic + assignment (InternalFrame metadata update)
+    kdf["total"] = kdf["bedrooms"] + kdf["accommodates"]
+    out = kdf.to_pandas()
+    assert np.allclose(out["total"], airbnb_pdf["bedrooms"] + airbnb_pdf["accommodates"])
+    # boolean filtering
+    cheap = kdf[kdf["price"] < 100]
+    assert cheap.to_pandas()["price"].max() < 100
+    assert kdf["price"].mean() == pytest.approx(airbnb_pdf["price"].mean(), rel=1e-9)
+
+
+def test_kdf_groupby_sort(spark, airbnb_pdf):
+    kdf = ks.DataFrame(spark.createDataFrame(airbnb_pdf))
+    g = kdf.groupby("room_type").count()
+    assert len(g) == airbnb_pdf["room_type"].nunique()
+    top = kdf.sort_values("price", ascending=False).head(3).to_pandas()
+    assert list(top["price"]) == sorted(airbnb_pdf["price"], reverse=True)[:3]
+
+
+def test_ks_sql(spark, airbnb_pdf):
+    kdf = ks.DataFrame(spark.createDataFrame(airbnb_pdf))
+    out = ks.sql("SELECT room_type, COUNT(*) AS n FROM {kdf} GROUP BY room_type",
+                 kdf=kdf)
+    pdf = out.to_pandas()
+    assert pdf["n"].sum() == len(airbnb_pdf)
+
+
+def test_ks_read_delta(spark, airbnb_pdf, tmp_path):
+    path = str(tmp_path / "tbl")
+    spark.createDataFrame(airbnb_pdf).write.format("delta").save(path)
+    kdf = ks.read_delta(path)
+    assert len(kdf) == len(airbnb_pdf)
+    ks.set_option("compute.shortcut_limit", 10)
+    assert ks.get_option("compute.shortcut_limit") == 10
+    ks.reset_option("compute.shortcut_limit")
+
+
+def _trend_series(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = pd.date_range("2020-01-01", periods=n, freq="D")
+    trend = np.linspace(10, 30, n)
+    weekly = 3 * np.sin(2 * np.pi * np.arange(n) / 7)
+    y = trend + weekly + rng.normal(0, 0.5, n)
+    return pd.DataFrame({"ds": ds, "y": y})
+
+
+def test_prophet_fit_forecast():
+    df = _trend_series()
+    m = Prophet(weekly_seasonality=True, yearly_seasonality=False)
+    m.fit(df)
+    future = m.make_future_dataframe(periods=30)
+    fc = m.predict(future)
+    assert {"ds", "yhat", "yhat_lower", "yhat_upper", "trend"} <= set(fc.columns)
+    assert len(fc) == len(df) + 30
+    # in-sample fit is tight
+    insample = fc.iloc[:len(df)]
+    rmse = float(np.sqrt(np.mean((insample["yhat"].values - df["y"].values) ** 2)))
+    assert rmse < 1.0
+    # forecast continues the upward trend
+    assert fc["yhat"].iloc[-1] > df["y"].iloc[:50].mean()
+    assert m.changepoints is not None and len(m.changepoints) > 0
+    fig = m.plot(fc)
+    assert fig is not None
+    fig2 = m.plot_components(fc)
+    assert fig2 is not None
+
+
+def test_adf_acf_pacf():
+    rng = np.random.default_rng(1)
+    stationary = rng.normal(0, 1, 500)
+    walk = np.cumsum(rng.normal(0, 1, 500))
+    stat_s, p_s, *_ = adfuller(stationary)
+    stat_w, p_w, *_ = adfuller(walk)
+    assert p_s < 0.05      # stationary: reject unit root
+    assert p_w > 0.1       # random walk: fail to reject
+    a = acf(stationary, nlags=10)
+    assert a[0] == 1.0 and np.all(np.abs(a[1:]) < 0.2)
+    # AR(1) signature in pacf: single spike at lag 1
+    ar = np.zeros(1000)
+    for i in range(1, 1000):
+        ar[i] = 0.7 * ar[i - 1] + rng.normal()
+    p = pacf(ar, nlags=5)
+    assert p[1] > 0.5 and np.all(np.abs(p[2:]) < 0.15)
+
+
+def test_arima_fit_forecast():
+    rng = np.random.default_rng(2)
+    n = 400
+    y = np.zeros(n)
+    for i in range(1, n):
+        y[i] = 0.6 * y[i - 1] + rng.normal(0, 1)
+    res = ARIMA(y, order=(1, 0, 0)).fit()
+    # recovered AR coefficient
+    assert res.params[1] == pytest.approx(0.6, abs=0.12)
+    f = res.forecast(steps=5)
+    assert len(f) == 5
+    assert np.isfinite(res.aic)
+    assert "ARIMA(1,0,0)" in res.summary()
+
+
+def test_arima_differencing():
+    rng = np.random.default_rng(3)
+    drift = np.cumsum(0.5 + rng.normal(0, 0.3, 300))
+    res = ARIMA(drift, order=(0, 1, 1)).fit()
+    f = res.forecast(steps=10)
+    # forecast keeps drifting upward at roughly the drift rate
+    assert f[-1] > drift[-1] + 2.0
+
+
+def test_holt_methods():
+    rng = np.random.default_rng(4)
+    y = 5 + 0.3 * np.arange(200) + rng.normal(0, 0.5, 200)
+    fit = Holt(y).fit()
+    fc = fit.forecast(10)
+    expect = 5 + 0.3 * np.arange(200, 210)
+    assert np.allclose(fc, expect, atol=3.0)
+    # damped forecasts grow slower than linear
+    fc_damped = Holt(y, damped=True).fit(damping_trend=0.8).forecast(10)
+    assert fc_damped[-1] < fc[-1]
+    ses = SimpleExpSmoothing(y).fit(smoothing_level=0.3)
+    assert len(ses.fittedvalues) == len(y)
