@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""End-to-end benchmark: the course's ML 02–ML 13 compute path on TPU.
+
+Runs the BASELINE.json config suite against a deterministic SF-Airbnb-shaped
+dataset (the real one is blob-hosted; same schema/size class, seed 42):
+
+  ML 02/03  StringIndexer+OHE+VectorAssembler+LinearRegression fit+predict
+  ML 06/07  DecisionTree + RandomForest fit+predict
+  ML 11     XGBoost-equivalent (tpu_hist boosted trees) fit+predict
+  ML 12     mapInPandas batch inference
+  ML 13     applyInPandas per-group training
+
+Prints ONE JSON line: wall-clock of the whole suite (after a compile warmup
+pass on small data, so the number measures steady-state execution the way
+the reference cluster — with its JIT-warm JVM — was measured).
+`vs_baseline` is suite_rows/sec ÷ 2000 rows/s, a conservative anchor for the
+same workload class on the reference's 8×A10G Databricks cluster
+(BASELINE.json publishes no numbers; SURVEY §6)."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 60_000
+BASELINE_ROWS_PER_SEC = 2000.0
+
+
+def build_dataset(n):
+    from sml_tpu.courseware import make_airbnb_dataset
+    from sml_tpu.frame.session import get_session
+    pdf = make_airbnb_dataset(n=n, seed=42)
+    return get_session().createDataFrame(pdf)
+
+
+def run_suite(df, n_rows):
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import (Imputer, OneHotEncoder, StringIndexer,
+                                    VectorAssembler)
+    from sml_tpu.ml.regression import (DecisionTreeRegressor,
+                                       RandomForestRegressor)
+    from sml_tpu.xgboost import XgboostRegressor
+
+    timings = {}
+    train, test = df.randomSplit([0.8, 0.2], seed=42)
+    train.cache()
+    test.cache()
+    cat_cols = ["neighbourhood_cleansed", "room_type", "property_type"]
+    num_cols = ["accommodates", "bathrooms", "bedrooms", "beds",
+                "minimum_nights", "number_of_reviews", "review_scores_rating"]
+    idx = [c + "_idx" for c in cat_cols]
+    ohe = [c + "_ohe" for c in cat_cols]
+    imp = [c + "_imp" for c in num_cols]
+    prep = [
+        Imputer(strategy="median", inputCols=num_cols, outputCols=imp),
+        StringIndexer(inputCols=cat_cols, outputCols=idx, handleInvalid="skip"),
+    ]
+    ev = RegressionEvaluator(labelCol="price")
+
+    # ML 02/03: linear pipeline
+    t0 = time.perf_counter()
+    lr_pipe = Pipeline(stages=prep + [
+        OneHotEncoder(inputCols=idx, outputCols=ohe),
+        VectorAssembler(inputCols=ohe + imp, outputCol="features"),
+    ])
+    from sml_tpu.ml.regression import LinearRegression
+    lr_model = Pipeline(stages=lr_pipe.getStages()
+                        + [LinearRegression(labelCol="price")]).fit(train)
+    rmse_lr = ev.evaluate(lr_model.transform(test))
+    timings["ml02_lr"] = time.perf_counter() - t0
+
+    # ML 06/07: trees (indexed categoricals, no OHE — ML 06:42)
+    tree_feats = VectorAssembler(inputCols=idx + imp, outputCol="features")
+    t0 = time.perf_counter()
+    dt_model = Pipeline(stages=prep + [tree_feats,
+                        DecisionTreeRegressor(labelCol="price", maxDepth=5,
+                                              maxBins=40)]).fit(train)
+    rmse_dt = ev.evaluate(dt_model.transform(test))
+    timings["ml06_dt"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rf_model = Pipeline(stages=prep + [tree_feats,
+                        RandomForestRegressor(labelCol="price", maxDepth=6,
+                                              numTrees=20, maxBins=40,
+                                              seed=42)]).fit(train)
+    rmse_rf = ev.evaluate(rf_model.transform(test))
+    timings["ml07_rf"] = time.perf_counter() - t0
+
+    # ML 11: boosted trees, log-price target (exp back-transform)
+    from sml_tpu.frame import functions as F
+    t0 = time.perf_counter()
+    log_train = train.withColumn("label", F.log(F.col("price")))
+    log_test = test.withColumn("label", F.log(F.col("price")))
+    xgb_model = Pipeline(stages=prep + [tree_feats,
+                         XgboostRegressor(n_estimators=40, learning_rate=0.15,
+                                          max_depth=6, max_bins=64,
+                                          random_state=42)]).fit(log_train)
+    pred = xgb_model.transform(log_test).withColumn(
+        "prediction", F.exp(F.col("prediction")))
+    rmse_xgb = ev.evaluate(pred)
+    timings["ml11_xgb"] = time.perf_counter() - t0
+
+    # ML 12: mapInPandas batch inference with the fitted LR model
+    t0 = time.perf_counter()
+    lr_last = lr_model.stages[-1]
+    scored_input = test
+    for s in lr_model.stages[:-1]:
+        scored_input = s.transform(scored_input)
+    w = lr_last.coefficients.toArray()
+    b = lr_last.intercept
+
+    def predict_batches(it):
+        import pandas as pd
+        for pdf in it:
+            X = np.stack([v.toArray() for v in pdf["features"]])
+            yield pd.DataFrame({"prediction": X @ w + b})
+
+    n_scored = scored_input.mapInPandas(predict_batches,
+                                        "prediction double").count()
+    timings["ml12_mapinpandas"] = time.perf_counter() - t0
+
+    # ML 13: per-group training fan-out
+    t0 = time.perf_counter()
+
+    def train_group(pdf):
+        import pandas as pd
+        from sklearn.linear_model import LinearRegression as SkLR
+        cols = ["accommodates", "bedrooms"]
+        g = pdf.dropna(subset=cols + ["price"])
+        if len(g) < 5:
+            return pd.DataFrame({"room_type": [pdf["room_type"].iloc[0]],
+                                 "n": [len(g)], "mse": [float("nan")]})
+        m = SkLR().fit(g[cols], g["price"])
+        mse = float(np.mean((m.predict(g[cols]) - g["price"]) ** 2))
+        return pd.DataFrame({"room_type": [g["room_type"].iloc[0]],
+                             "n": [len(g)], "mse": [mse]})
+
+    n_groups = train.groupby("room_type").applyInPandas(
+        train_group, "room_type string, n bigint, mse double").count()
+    timings["ml13_applyinpandas"] = time.perf_counter() - t0
+
+    metrics = {"rmse_lr": rmse_lr, "rmse_dt": rmse_dt, "rmse_rf": rmse_rf,
+               "rmse_xgb": rmse_xgb, "rows_scored": n_scored,
+               "groups": n_groups}
+    return timings, metrics
+
+
+def main():
+    import jax
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    df = build_dataset(N_ROWS)
+    df.cache()
+    # warmup pass at FULL shapes so the timed pass measures steady-state
+    # execution, not XLA compiles (shapes are part of the compile key)
+    t0 = time.perf_counter()
+    run_suite(df, N_ROWS)
+    print(f"warmup (incl. compiles): {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    timings, metrics = run_suite(df, N_ROWS)
+    wall = time.perf_counter() - t0
+    for k, v in sorted(timings.items()):
+        print(f"  {k:22s} {v:7.2f}s", file=sys.stderr)
+    for k, v in sorted(metrics.items()):
+        print(f"  {k:22s} {v:10.3f}", file=sys.stderr)
+    rows_per_sec = N_ROWS / wall
+    print(json.dumps({
+        "metric": "ml02-ml13 suite wall-clock (60k-row SF-Airbnb-class, fit+predict)",
+        "value": round(wall, 3),
+        "unit": "seconds",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,339 @@
+"""Courseware harness: classroom setup, answer validation, test logging.
+
+Re-implements the reference's include files (SURVEY §1 L9):
+- `SML/Includes/Classroom-Setup.py`: per-user working dirs (`:12-20`),
+  idempotent dataset install with a `reinstall` widget (`:32-69`), CI
+  experiment redirection (`:83-92`), stream-readiness polling (`:96-110`).
+- `SML/Includes/Class-Utility-Methods.py`: username/paths derivation
+  (`:51-84`), per-user database create/drop (`:134-150`), the hash-based
+  answer-validation harness (`:158-256`), `allDone()` (`:297-351`),
+  `FILL_IN` (`:356-363`).
+- `SML/Includes/Reset.py`: wipe + re-setup (`:10-22`).
+
+Datasets are generated deterministically (the reference copies them from
+Azure blob storage, unavailable here); same schemas, fixed seeds.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import re
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from .conf import GLOBAL_CONF
+from .frame.session import get_session
+from .native.hashing import hash_columns
+
+
+class FILL_IN:
+    """Placeholder keeping unsolved lab cells runnable
+    (`Class-Utility-Methods.py:356-363`)."""
+    VALUE = None
+    LIST = []
+    SCHEMA = None
+    DATAFRAME = None
+    INT = 0
+
+
+def get_username() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:
+        return os.environ.get("USER", "student")
+
+
+def get_clean_username(username: Optional[str] = None) -> str:
+    u = (username or get_username()).lower()
+    return re.sub(r"[^a-z0-9]", "_", u)
+
+
+class ClassroomSetup:
+    """Config + per-user workspace + dataset install."""
+
+    def __init__(self, course_name: str = "sml-tpu",
+                 base_dir: Optional[str] = None,
+                 widgets: Optional[Dict[str, str]] = None):
+        self.course_name = course_name
+        self.username = get_username()
+        self.clean_username = get_clean_username(self.username)
+        base = base_dir or os.path.join(os.getcwd(), "_classroom")
+        self.user_home = os.path.join(base, self.clean_username, course_name)
+        self.working_dir = os.path.join(self.user_home, "working")
+        self.datasets_dir = os.path.join(base, "_datasets", course_name)
+        self.widgets = dict(widgets or {})
+        os.makedirs(self.working_dir, exist_ok=True)
+        GLOBAL_CONF.set("sml.training.module-name", course_name)
+        GLOBAL_CONF.set("sml.training.username", self.username)
+        self.database = f"sml_{self.clean_username}_db"
+        # CI hook: when run as a job, redirect tracking (Classroom-Setup:83-92)
+        if os.environ.get("SML_JOB_ID"):
+            from . import tracking
+            tracking.set_experiment(
+                f"Test Results/Experiments/{os.environ['SML_JOB_ID']}")
+
+    def get_widget(self, name: str, default: str = "") -> str:
+        """Guarded widget read with fallback (`Classroom-Setup.py:65-69`)."""
+        return self.widgets.get(name, default)
+
+    # -- datasets ---------------------------------------------------------
+    def install_datasets(self, reinstall: bool = False) -> str:
+        marker = os.path.join(self.datasets_dir, "_SUCCESS")
+        if os.path.exists(marker) and not reinstall:
+            return self.datasets_dir
+        if os.path.exists(self.datasets_dir):
+            shutil.rmtree(self.datasets_dir)
+        os.makedirs(self.datasets_dir, exist_ok=True)
+        session = get_session()
+        airbnb = make_airbnb_dataset()
+        raw_dir = os.path.join(self.datasets_dir, "airbnb", "sf-listings")
+        os.makedirs(raw_dir, exist_ok=True)
+        airbnb.to_csv(os.path.join(raw_dir, "sf-listings-2019-03-06.csv"),
+                      index=False)
+        clean = airbnb.dropna().reset_index(drop=True)
+        session.createDataFrame(clean).write.mode("overwrite").parquet(
+            os.path.join(raw_dir, "sf-listings-2019-03-06-clean.parquet"))
+        session.createDataFrame(clean).write.format("delta").mode("overwrite") \
+            .save(os.path.join(raw_dir, "sf-listings-2019-03-06-clean.delta"))
+        ml = make_movielens_dataset()
+        ml_dir = os.path.join(self.datasets_dir, "movielens")
+        os.makedirs(ml_dir, exist_ok=True)
+        session.createDataFrame(ml).write.mode("overwrite").parquet(
+            os.path.join(ml_dir, "ratings.parquet"))
+        dups = make_dedup_dataset()
+        dedup_dir = os.path.join(self.datasets_dir, "dedup")
+        os.makedirs(dedup_dir, exist_ok=True)
+        dups.to_csv(os.path.join(dedup_dir, "people-with-dups.txt"),
+                    index=False, sep=":")
+        with open(marker, "w") as f:
+            f.write(str(time.time()))
+        return self.datasets_dir
+
+    def path_exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def reset(self) -> None:
+        """`Reset.py:10-22`: wipe the working dir and reinstall."""
+        if os.path.exists(self.working_dir):
+            shutil.rmtree(self.working_dir)
+        os.makedirs(self.working_dir, exist_ok=True)
+        self.install_datasets(reinstall=False)
+
+
+# ------------------------------------------------------------- synthetic data
+def make_airbnb_dataset(n: int = 10000, seed: int = 42) -> pd.DataFrame:
+    """SF-Airbnb-shaped listings table (schema of the course's cleaned set)."""
+    rng = np.random.default_rng(seed)
+    hoods = ["Mission", "South of Market", "Western Addition", "Castro",
+             "Bernal Heights", "Haight Ashbury", "Noe Valley", "Outer Sunset",
+             "Inner Richmond", "Nob Hill", "Pacific Heights", "Chinatown",
+             "Downtown", "Marina", "Potrero Hill", "Russian Hill",
+             "Outer Richmond", "Excelsior", "Twin Peaks", "Glen Park",
+             "Bayview", "Inner Sunset", "Lakeshore", "North Beach",
+             "Visitacion Valley", "Parkside", "Ocean View", "Mission Bay",
+             "West of Twin Peaks", "Seacliff", "Presidio Heights",
+             "Financial District", "Crocker Amazon", "Diamond Heights",
+             "Golden Gate Park", "Presidio"]
+    room_types = ["Entire home/apt", "Private room", "Shared room"]
+    property_types = ["Apartment", "House", "Condominium", "Townhouse",
+                      "Guest suite", "Boutique hotel"]
+    bedrooms = rng.choice([0, 1, 2, 3, 4, 5], n, p=[.08, .42, .28, .14, .06, .02]).astype(float)
+    accommodates = np.clip(bedrooms * 2 + rng.integers(0, 3, n), 1, 16).astype(float)
+    bathrooms = rng.choice([1.0, 1.5, 2.0, 2.5, 3.0], n, p=[.55, .15, .2, .06, .04])
+    review_scores = np.clip(rng.normal(94, 7, n), 20, 100)
+    hood_effect = rng.normal(0, 0.25, len(hoods))
+    hood_idx = rng.integers(0, len(hoods), n)
+    room_mult = np.array([1.0, 0.55, 0.35])
+    room_idx = rng.choice(3, n, p=[.62, .33, .05])
+    price = np.exp(4.1 + 0.32 * bedrooms + 0.06 * accommodates
+                   + hood_effect[hood_idx] + rng.normal(0, 0.35, n)) \
+        * room_mult[room_idx]
+    pdf = pd.DataFrame({
+        "host_is_superhost": rng.choice(["t", "f"], n, p=[0.25, 0.75]),
+        "instant_bookable": rng.choice(["t", "f"], n, p=[0.4, 0.6]),
+        "host_total_listings_count": rng.integers(1, 20, n).astype(float),
+        "neighbourhood_cleansed": np.array(hoods)[hood_idx],
+        "latitude": 37.72 + rng.random(n) * 0.09,
+        "longitude": -122.51 + rng.random(n) * 0.12,
+        "property_type": rng.choice(property_types, n),
+        "room_type": np.array(room_types)[room_idx],
+        "accommodates": accommodates,
+        "bathrooms": bathrooms,
+        "bedrooms": bedrooms,
+        "beds": np.maximum(bedrooms, 1) + rng.integers(0, 2, n),
+        "bed_type": rng.choice(["Real Bed", "Futon", "Couch"], n, p=[.94, .04, .02]),
+        "minimum_nights": rng.integers(1, 30, n).astype(float),
+        "number_of_reviews": rng.integers(0, 400, n).astype(float),
+        "review_scores_rating": review_scores,
+        "review_scores_accuracy": np.clip(rng.normal(9.6, 0.7, n), 2, 10),
+        "review_scores_cleanliness": np.clip(rng.normal(9.5, 0.8, n), 2, 10),
+        "review_scores_checkin": np.clip(rng.normal(9.7, 0.5, n), 2, 10),
+        "review_scores_communication": np.clip(rng.normal(9.7, 0.5, n), 2, 10),
+        "review_scores_location": np.clip(rng.normal(9.6, 0.6, n), 2, 10),
+        "review_scores_value": np.clip(rng.normal(9.4, 0.8, n), 2, 10),
+        "price": np.round(price, 0),
+    })
+    # sprinkle missing values like the raw course data (imputation targets)
+    for c in ("bedrooms", "bathrooms", "review_scores_rating"):
+        mask = rng.random(n) < 0.03
+        pdf.loc[mask, c] = np.nan
+    return pdf
+
+
+def make_movielens_dataset(n_users: int = 1000, n_items: int = 400,
+                           n_ratings: int = 50000, seed: int = 7) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    rank = 6
+    U = rng.normal(0, 0.6, (n_users, rank))
+    V = rng.normal(0, 0.6, (n_items, rank))
+    u = rng.integers(0, n_users, n_ratings)
+    i = rng.integers(0, n_items, n_ratings)
+    raw = (U[u] * V[i]).sum(1) + 3.4 + rng.normal(0, 0.4, n_ratings)
+    return pd.DataFrame({
+        "userId": u.astype(np.int64), "movieId": i.astype(np.int64),
+        "rating": np.clip(np.round(raw * 2) / 2, 0.5, 5.0),
+        "timestamp": rng.integers(9e8, 1e9, n_ratings),
+    }).drop_duplicates(["userId", "movieId"]).reset_index(drop=True)
+
+
+def make_dedup_dataset(n: int = 103000, n_unique: int = 100000,
+                       seed: int = 11) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    first = [f"Person{i}" for i in range(n_unique)]
+    pdf = pd.DataFrame({
+        "firstName": first,
+        "lastName": [f"Family{i % 977}" for i in range(n_unique)],
+        "ssn": [f"{900 + i // 10000:03d}-{(i // 100) % 100:02d}-{i % 10000:04d}"
+                for i in range(n_unique)],
+    })
+    dup_idx = rng.choice(n_unique, n - n_unique, replace=False)
+    dups = pdf.iloc[dup_idx].copy()
+    dups["firstName"] = dups["firstName"].str.upper()  # case variants
+    dups["ssn"] = dups["ssn"].str.replace("-", "", regex=False)
+    out = pd.concat([pdf, dups], ignore_index=True)
+    return out.sample(frac=1.0, random_state=seed).reset_index(drop=True)
+
+
+# ------------------------------------------------------- validation harness
+class TestResults:
+    """Hash-validated answer harness (`Class-Utility-Methods.py:158-256`)."""
+
+    def __init__(self):
+        self.results: List[Dict[str, Any]] = []
+
+    @staticmethod
+    def to_hash(value) -> int:
+        """Stable hash via the engine's Murmur3 kernel (the course hashes
+        answers with Spark's `hash()` — `Class-Utility-Methods.py:161-165`)."""
+        s = pd.Series([str(value)])
+        return int(hash_columns([s], n=1)[0])
+
+    def validate_your_answer(self, what: str, expected_hash: int, answer) -> bool:
+        got = self.to_hash(answer)
+        passed = got == expected_hash
+        self.results.append({"what": what, "passed": passed,
+                             "expected": expected_hash, "got": got})
+        status = "passed" if passed else f"FAILED (hash {got})"
+        print(f"Validate {what}: {status}")
+        return passed
+
+    def validate_your_schema(self, what: str, df, expected: Dict[str, str]) -> bool:
+        actual = {f.name: f.dataType.simpleString() for f in df.schema.fields}
+        missing = {k: v for k, v in expected.items() if actual.get(k) != v}
+        passed = not missing
+        self.results.append({"what": what, "passed": passed,
+                             "expected": expected, "got": actual})
+        print(f"Validate schema {what}: {'passed' if passed else f'FAILED {missing}'}")
+        return passed
+
+    def summarize_your_results(self) -> str:
+        lines = ["<html><body><table>",
+                 "<tr><th>Test</th><th>Result</th></tr>"]
+        for r in self.results:
+            lines.append(f"<tr><td>{r['what']}</td>"
+                         f"<td>{'passed' if r['passed'] else 'FAILED'}</td></tr>")
+        lines.append("</table></body></html>")
+        n_pass = sum(r["passed"] for r in self.results)
+        print(f"{n_pass}/{len(self.results)} tests passed")
+        return "\n".join(lines)
+
+    @property
+    def all_passed(self) -> bool:
+        return all(r["passed"] for r in self.results)
+
+
+_results = TestResults()
+toHash = TestResults.to_hash
+validateYourAnswer = _results.validate_your_answer
+validateYourSchema = _results.validate_your_schema
+summarizeYourResults = _results.summarize_your_results
+
+
+def log_your_test(dir_path: str, name: str, value: float) -> None:
+    """Grading CSV logger (`Class-Utility-Methods.py:233-256`)."""
+    os.makedirs(dir_path, exist_ok=True)
+    clean = re.sub(r"[^a-zA-Z0-9]", "_", name)
+    pd.DataFrame({"name": [name], "value": [float(value)]}).to_csv(
+        os.path.join(dir_path, f"{clean}.csv"), index=False)
+
+
+def load_your_test_results(dir_path: str) -> pd.DataFrame:
+    frames = []
+    for f in sorted(os.listdir(dir_path)):
+        if f.endswith(".csv"):
+            frames.append(pd.read_csv(os.path.join(dir_path, f)))
+    return pd.concat(frames, ignore_index=True) if frames else \
+        pd.DataFrame(columns=["name", "value"])
+
+
+def load_your_test_map(dir_path: str) -> Dict[str, float]:
+    pdf = load_your_test_results(dir_path)
+    return dict(zip(pdf["name"], pdf["value"]))
+
+
+# ------------------------------------------------------------ async readiness
+def until_stream_is_ready(query, min_batches: int = 2,
+                          timeout_s: float = 60.0) -> None:
+    """Poll a streaming query until it has processed batches
+    (`Classroom-Setup.py:96-110`)."""
+    start = time.time()
+    while time.time() - start < timeout_s:
+        if getattr(query, "isActive", False) and \
+                len(getattr(query, "recentProgress", [])) >= min_batches:
+            return
+        time.sleep(0.2)
+    raise TimeoutError("stream did not become ready in time")
+
+
+untilStreamIsReady = until_stream_is_ready
+
+
+def wait_for_model(name: str, version: int, stage: Optional[str] = None,
+                   timeout_s: float = 60.0):
+    """Registry-readiness polling (`Labs/ML 05L:179-199`)."""
+    from . import tracking
+    client = tracking.MlflowClient()
+    start = time.time()
+    while time.time() - start < timeout_s:
+        try:
+            mv = client.get_model_version(name, version)
+            if mv.status == "READY" and (stage is None or
+                                         mv.current_stage == stage):
+                return mv
+        except ValueError:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"model {name}/{version} not ready after {timeout_s}s")
+
+
+def all_done(namespace: Dict[str, Any]) -> str:
+    """Advertise defined names (`Class-Utility-Methods.py:297-351`)."""
+    names = [k for k in namespace if not k.startswith("_")]
+    html = "<b>All done!</b><br/>" + ", ".join(sorted(names))
+    print(f"All done! Defined: {', '.join(sorted(names)[:20])}")
+    return html

@@ -94,11 +94,36 @@ def data_parallel(fn: Callable, *, out_replicated: bool = True,
     return jax.jit(wrapped)
 
 
-def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True):
-    """One-shot: stage arrays sharded, run fn(blocks..., mask) under
-    jit+shard_map, return host numpy results."""
+_compiled_cache: dict = {}
+
+
+def cached_data_parallel(fn: Callable, *, out_replicated: bool = True,
+                         replicated_argnums: Tuple[int, ...] = ()) -> Callable:
+    """data_parallel with a program cache keyed by (fn, mesh, flags).
+
+    jax.jit caches per function object; wrapping a fresh closure per fit
+    would recompile every call. Callers must pass module-level fns (stable
+    identity) for the cache to hit.
+    """
+    mesh = meshlib.get_mesh()
+    key = (fn, id(mesh), out_replicated, replicated_argnums)
+    if key not in _compiled_cache:
+        _compiled_cache[key] = data_parallel(
+            fn, out_replicated=out_replicated,
+            replicated_argnums=replicated_argnums)
+    return _compiled_cache[key]
+
+
+def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True,
+                      replicated: Tuple = ()):
+    """One-shot: stage arrays sharded, run fn(blocks..., mask, *replicated)
+    under jit+shard_map, return host numpy results. `replicated` values are
+    broadcast to all chips (small parameter vectors)."""
     staged = stage_sharded(*arrays)
     dev_args, mask, _ = staged[:-2], staged[-2], staged[-1]
-    compiled = data_parallel(fn, out_replicated=out_replicated)
-    out = compiled(*dev_args, mask)
+    n_lead = len(dev_args) + 1
+    rep_nums = tuple(range(n_lead, n_lead + len(replicated)))
+    compiled = cached_data_parallel(fn, out_replicated=out_replicated,
+                                    replicated_argnums=rep_nums)
+    out = compiled(*dev_args, mask, *replicated)
     return jax.tree_util.tree_map(np.asarray, out)

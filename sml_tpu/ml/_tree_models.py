@@ -128,7 +128,9 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                   step_size: float = 0.1, reg_lambda: float = 0.0,
                   gamma: float = 0.0, boosting: bool = False,
                   missing: Optional[float] = None) -> _EnsembleSpec:
-    """The one training loop behind every tree learner."""
+    """The one training path behind every tree learner: bin on host, then
+    the WHOLE forest/boosting fit runs as a single on-device program
+    (`tree_impl.fit_ensemble_on_device`)."""
     if missing is not None and not np.isnan(missing):
         X = X.copy()
         X[X == missing] = np.nan
@@ -138,61 +140,19 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                     feature_k=feature_k or F, min_instances=min_instances,
                     min_info_gain=min_info_gain, reg_lambda=reg_lambda,
                     gamma=gamma)
-    rng = np.random.default_rng(seed)
-    trees: List[FittedTree] = []
-    n = len(y)
-
-    if not boosting:
-        g_dev = stage_aligned(-y.astype(np.float32), staged.n_padded)
-        h_dev = stage_aligned(np.ones(n, dtype=np.float32), staged.n_padded)
-        for t in range(n_trees):
-            if bootstrap and n_trees > 1:
-                w = rng.poisson(subsample, n).astype(np.float32)
-            elif subsample < 1.0:
-                w = (rng.random(n) < subsample).astype(np.float32)
-            else:
-                w = np.ones(n, dtype=np.float32)
-            w_dev = stage_aligned(w, staged.n_padded)
-            import jax
-            feat_key = jax.random.key_data(jax.random.PRNGKey(seed + 7919 * t))
-            trees.append(fit_tree(staged.binned_dev, g_dev, h_dev, w_dev,
-                                  spec, feat_key=feat_key))
-        mode = "binary" if loss == "logistic" else "regression"
-        return _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0, F, mode)
-
-    # boosting
-    if loss == "logistic":
-        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-        base = float(np.log(p0 / (1 - p0)))
-    else:
-        base = float(y.mean())
-    margin = np.full(n, base, dtype=np.float32)
-    w_dev = stage_aligned(np.ones(n, dtype=np.float32), staged.n_padded)
-    import jax
-    for t in range(n_trees):
-        if loss == "logistic":
-            p = 1.0 / (1.0 + np.exp(-margin))
-            grad = (p - y).astype(np.float32)
-            hess = np.maximum(p * (1 - p), 1e-6).astype(np.float32)
-        else:
-            grad = (margin - y).astype(np.float32)
-            hess = np.ones(n, dtype=np.float32)
-        g_dev = stage_aligned(grad, staged.n_padded)
-        h_dev = stage_aligned(hess, staged.n_padded)
-        if subsample < 1.0:
-            w = (rng.random(n) < subsample).astype(np.float32)
-            w_dev_t = stage_aligned(w, staged.n_padded)
-        else:
-            w_dev_t = w_dev
-        feat_key = jax.random.key_data(jax.random.PRNGKey(seed + 7919 * t))
-        tree = fit_tree(staged.binned_dev, g_dev, h_dev, w_dev_t, spec,
-                        feat_key=feat_key)
-        trees.append(tree)
-        margin = margin + step_size * tree_impl.predict_tree(
-            staged.binned, tree, max_depth).astype(np.float32)
-    weights = np.full(len(trees), step_size, dtype=np.float32)
+    es = tree_impl.EnsembleSpec(
+        tree=spec, n_trees=n_trees, loss=loss, boosting=boosting,
+        bootstrap=bootstrap and n_trees > 1, subsample=float(subsample),
+        step_size=float(step_size))
+    y_dev = stage_aligned(y.astype(np.float32), staged.n_padded)
+    trees, base = tree_impl.fit_ensemble_on_device(
+        staged.binned_dev, y_dev, staged.mask_dev, es, seed=seed)
     mode = "binary" if loss == "logistic" else "regression"
-    return _EnsembleSpec(trees, max_depth, staged.binning, weights, base, F, mode)
+    if boosting:
+        weights = np.full(len(trees), step_size, dtype=np.float32)
+        return _EnsembleSpec(trees, max_depth, staged.binning, weights, base,
+                             F, mode)
+    return _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0, F, mode)
 
 
 # ---------------------------------------------------------------------------

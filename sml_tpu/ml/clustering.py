@@ -23,6 +23,10 @@ from .linalg import DenseVector
 from ._staging import data_parallel, extract_features, stage_sharded
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
 def _lloyd_program(k: int, max_iter: int):
     def program(X, mask, init_centers):
         def step(_, centers):
@@ -90,8 +94,9 @@ class KMeans(Estimator):
         init = np.stack(centers).astype(np.float32)
 
         Xd, mask, _ = stage_sharded(X.astype(np.float32))
-        program = data_parallel(_lloyd_program(k, max_iter),
-                                replicated_argnums=(2,))
+        from ._staging import cached_data_parallel
+        program = cached_data_parallel(_lloyd_program(k, max_iter),
+                                       replicated_argnums=(2,))
         final_centers, cost = program(Xd, mask, init)
         m = KMeansModel(centers=np.asarray(final_centers),
                         trainingCost=float(cost))

@@ -30,6 +30,22 @@ def _pred_label(df, predictionCol: str, labelCol: str):
     return pred[ok], lab[ok]
 
 
+def _reg_stats(p, l, mask):
+    # five sufficient statistics, one fused data-parallel pass
+    n = coll.psum(jnp.sum(mask))
+    se = coll.psum(jnp.sum(mask * (p - l) ** 2))
+    ae = coll.psum(jnp.sum(mask * jnp.abs(p - l)))
+    sl = coll.psum(jnp.sum(mask * l))
+    sl2 = coll.psum(jnp.sum(mask * l * l))
+    return n, se, ae, sl, sl2
+
+
+def _acc_stats(p, l, mask):
+    n = coll.psum(jnp.sum(mask))
+    c = coll.psum(jnp.sum(mask * (p == l)))
+    return c, n
+
+
 class RegressionEvaluator(Evaluator):
     def _init_params(self):
         self._declareParam("predictionCol", default="prediction", doc="prediction column")
@@ -53,18 +69,8 @@ class RegressionEvaluator(Evaluator):
         pred, lab = _pred_label(df, self.getOrDefault("predictionCol"),
                                 self.getOrDefault("labelCol"))
         metric = self.getOrDefault("metricName")
-
-        def stats(p, l, mask):
-            # five sufficient statistics, one psum each — a single fused pass
-            n = coll.psum(jnp.sum(mask))
-            se = coll.psum(jnp.sum(mask * (p - l) ** 2))
-            ae = coll.psum(jnp.sum(mask * jnp.abs(p - l)))
-            sl = coll.psum(jnp.sum(mask * l))
-            sl2 = coll.psum(jnp.sum(mask * l * l))
-            return n, se, ae, sl, sl2
-
         n, se, ae, sl, sl2 = run_data_parallel(
-            stats, pred.astype(np.float32), lab.astype(np.float32))
+            _reg_stats, pred.astype(np.float32), lab.astype(np.float32))
         n = float(n)
         if n == 0:
             return float("nan")
@@ -162,11 +168,8 @@ class MulticlassClassificationEvaluator(Evaluator):
                                 self.getOrDefault("labelCol"))
         metric = self.getOrDefault("metricName")
         if metric == "accuracy":
-            def acc(p, l, mask):
-                n = coll.psum(jnp.sum(mask))
-                c = coll.psum(jnp.sum(mask * (p == l)))
-                return c, n
-            c, n = run_data_parallel(acc, pred.astype(np.float32), lab.astype(np.float32))
+            c, n = run_data_parallel(_acc_stats, pred.astype(np.float32),
+                                     lab.astype(np.float32))
             return float(c) / float(n) if n else float("nan")
         classes = np.unique(np.concatenate([pred, lab]))
         stats = []

@@ -25,10 +25,9 @@ from .linalg import DenseVector, SparseVector, Vector
 
 
 def _as_object_series(values: List) -> pd.Series:
-    s = pd.Series([None] * len(values), dtype=object)
-    for i, v in enumerate(values):
-        s.iloc[i] = v
-    return s
+    arr = np.empty(len(values), dtype=object)
+    arr[:] = values
+    return pd.Series(arr)
 
 
 # --------------------------------------------------------------------------

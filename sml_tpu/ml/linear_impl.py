@@ -33,20 +33,21 @@ class LinearFit(NamedTuple):
     iterations: int
 
 
+def _gram_pass(Xb, yb, mask):
+    Xb = Xb * mask[:, None]
+    yb = yb * mask
+    ones = mask[:, None]
+    Xa = jnp.concatenate([Xb, ones], axis=1)
+    A = coll.psum(Xa.T @ Xa)            # MXU matmul then ICI allreduce
+    b = coll.psum(Xa.T @ yb)
+    n = coll.psum(jnp.sum(mask))
+    return A, b, n
+
+
 def gram_stats(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray, float]:
     """One data-parallel pass: (A = [X 1]^T [X 1], b = [X 1]^T y, n)."""
-
-    def pass_fn(Xb, yb, mask):
-        Xb = Xb * mask[:, None]
-        yb = yb * mask
-        ones = mask[:, None]
-        Xa = jnp.concatenate([Xb, ones], axis=1)
-        A = coll.psum(Xa.T @ Xa)            # MXU matmul then ICI allreduce
-        b = coll.psum(Xa.T @ yb)
-        n = coll.psum(jnp.sum(mask))
-        return A, b, n
-
-    A, b, n = run_data_parallel(pass_fn, X.astype(np.float32), y.astype(np.float32))
+    A, b, n = run_data_parallel(_gram_pass, X.astype(np.float32),
+                                y.astype(np.float32))
     return np.asarray(A, dtype=np.float64), np.asarray(b, dtype=np.float64), float(n)
 
 
@@ -117,6 +118,19 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     return LinearFit(w, intercept, maxIter)
 
 
+def _newton_pass(Xb, yb, mask, wb):
+    ones = mask[:, None]
+    Xa = jnp.concatenate([Xb * mask[:, None], ones], axis=1)
+    eta = Xa @ wb
+    p = jax.nn.sigmoid(eta)
+    Wdiag = jnp.maximum(p * (1 - p), 1e-6) * mask
+    grad = coll.psum(Xa.T @ ((p - yb) * mask))
+    hess = coll.psum((Xa * Wdiag[:, None]).T @ Xa)
+    ll = coll.psum(jnp.sum(mask * (yb * jax.nn.log_sigmoid(eta)
+                                   + (1 - yb) * jax.nn.log_sigmoid(-eta))))
+    return grad, hess, ll
+
+
 def fit_logistic(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
                  elasticNetParam: float = 0.0, fitIntercept: bool = True,
                  maxIter: int = 100, tol: float = 1e-7) -> LinearFit:
@@ -128,26 +142,14 @@ def fit_logistic(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     l2 = lam * (1 - float(elasticNetParam))
     l1 = lam * float(elasticNetParam)
 
-    def newton_pass(Xb, yb, wb, mask):
-        ones = mask[:, None]
-        Xa = jnp.concatenate([Xb * mask[:, None], ones], axis=1)
-        eta = Xa @ wb
-        p = jax.nn.sigmoid(eta)
-        Wdiag = jnp.maximum(p * (1 - p), 1e-6) * mask
-        grad = coll.psum(Xa.T @ ((p - yb) * mask))
-        hess = coll.psum((Xa * Wdiag[:, None]).T @ Xa)
-        ll = coll.psum(jnp.sum(mask * (yb * jax.nn.log_sigmoid(eta)
-                                       + (1 - yb) * jax.nn.log_sigmoid(-eta))))
-        return grad, hess, ll
-
     w = np.zeros(d + 1, dtype=np.float32)
     n_f = float(len(y))
     prev_ll = -np.inf
     iters = 0
     for it in range(maxIter):
         grad, hess, ll = run_data_parallel(
-            lambda Xb, yb, mask, _w=jnp.asarray(w): newton_pass(Xb, yb, _w, mask),
-            X.astype(np.float32), y.astype(np.float32))
+            _newton_pass, X.astype(np.float32), y.astype(np.float32),
+            replicated=(jnp.asarray(w),))
         grad = np.asarray(grad, dtype=np.float64)
         hess = np.asarray(hess, dtype=np.float64)
         if l2 > 0:

@@ -29,6 +29,10 @@ from .base import Estimator, Model, load_arrays, save_arrays
 from ._staging import data_parallel
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
 def _half_step_program(n_out: int, rank: int, reg: float):
     """Solve factors for one side given the other side's factors."""
 
@@ -110,8 +114,9 @@ class ALS(Estimator):
         uf = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
         itf = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
 
-        solve_users = data_parallel(_half_step_program(U, rank, reg))
-        solve_items = data_parallel(_half_step_program(I, rank, reg))
+        from ._staging import cached_data_parallel
+        solve_users = cached_data_parallel(_half_step_program(U, rank, reg))
+        solve_items = cached_data_parallel(_half_step_program(I, rank, reg))
 
         @jax.jit
         def gather(factors, idx):

@@ -131,20 +131,20 @@ def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-def _build_tree_program(spec: TreeSpec):
-    """The per-chip tree builder run under shard_map; collectives over 'data'."""
+def _make_tree_builder(spec: TreeSpec):
+    """Pure per-chip tree-build fn (called inside shard_map): one level-wise
+    pass, histograms as one-hot dots, psum merges. Returns stacked node
+    arrays as a single (5, n_nodes) f32 pack (one transfer, one scan slot)."""
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
     n_nodes = 2 ** (D + 1) - 1
 
-    def program(binned, grad, hess, weight, feat_rng):
-        # binned (n, F) int32; grad/hess/weight (n,); rng scalars uint32
+    def build(B1, binned, grad, hess, weight, feat_rng):
         n = binned.shape[0]
         node = jnp.zeros((n,), dtype=jnp.int32)
         active = weight > 0
         split_feature = jnp.full((n_nodes,), -1, dtype=jnp.int32)
         split_bin = jnp.zeros((n_nodes,), dtype=jnp.int32)
         gains = jnp.zeros((n_nodes,), dtype=jnp.float32)
-        # node stats accumulated as we go (root gets totals at level 0)
         node_G = jnp.zeros((n_nodes,), dtype=jnp.float32)
         node_H = jnp.zeros((n_nodes,), dtype=jnp.float32)
         node_W = jnp.zeros((n_nodes,), dtype=jnp.float32)
@@ -152,28 +152,18 @@ def _build_tree_program(spec: TreeSpec):
         for level in range(D):
             width = 2 ** level
             base = width - 1
-            lid = node - base  # local node id at this level; valid in [0,width)
+            lid = node - base
             in_level = active & (lid >= 0) & (lid < width)
             lid_c = jnp.where(in_level, lid, 0)
-            # --- histograms: scatter-add (n, F) entries into (width*F*B) ---
-            flat = (lid_c[:, None] * (F * B)
-                    + jnp.arange(F, dtype=jnp.int32)[None, :] * B
-                    + binned)
             wq = jnp.where(in_level, weight, 0.0)
-            gq = grad * wq
-            hq = hess * wq
-            hist_G = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
-                .add(jnp.broadcast_to(gq[:, None], (n, F)).ravel())
-            hist_H = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
-                .add(jnp.broadcast_to(hq[:, None], (n, F)).ravel())
-            hist_W = jnp.zeros((width * F * B,), jnp.float32).at[flat.ravel()] \
-                .add(jnp.broadcast_to(wq[:, None], (n, F)).ravel())
-            # the PLANET/Rabit merge: one ICI allreduce per level
-            hist = coll.psum(jnp.stack([hist_G, hist_H, hist_W]))
-            hG = hist[0].reshape(width, F, B)
-            hH = hist[1].reshape(width, F, B)
-            hW = hist[2].reshape(width, F, B)
-            # --- split scoring from cumulative sums ---------------------------
+            stats = jnp.stack([grad * wq, hess * wq, wq], axis=1)    # (n, 3)
+            node1hot = jax.nn.one_hot(lid_c, width, dtype=jnp.float32) \
+                * (wq > 0)[:, None]
+            ns = (node1hot[:, :, None] * stats[:, None, :]).reshape(n, width * 3)
+            hist = coll.psum(B1.T @ ns).reshape(F, B, width, 3)
+            hG = jnp.transpose(hist[..., 0], (2, 0, 1))              # (width,F,B)
+            hH = jnp.transpose(hist[..., 1], (2, 0, 1))
+            hW = jnp.transpose(hist[..., 2], (2, 0, 1))
             GL = jnp.cumsum(hG, axis=2)
             HL = jnp.cumsum(hH, axis=2)
             WL = jnp.cumsum(hW, axis=2)
@@ -186,11 +176,8 @@ def _build_tree_program(spec: TreeSpec):
                      - G ** 2 / (H + lam + 1e-12))
             ok = ((WL >= spec.min_instances)
                   & ((W - WL) >= spec.min_instances))
-            # last bin has empty right child; never a valid split
             ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
             if spec.feature_k < F:
-                # RF per-node feature subspace: exactly k features per node,
-                # chosen by ranking per-(node,feature) uniforms
                 u = jax.random.uniform(
                     jax.random.fold_in(jax.random.wrap_key_data(feat_rng), level),
                     (width, F))
@@ -203,9 +190,7 @@ def _build_tree_program(spec: TreeSpec):
             best_gain = 0.5 * jnp.take_along_axis(
                 score.reshape(width, F * B), flat_best[:, None], axis=1)[:, 0] \
                 - spec.gamma
-            do_split = (best_gain > spec.min_info_gain) & \
-                jnp.isfinite(best_gain)
-            # record per-node stats + chosen splits
+            do_split = (best_gain > spec.min_info_gain) & jnp.isfinite(best_gain)
             idx = base + jnp.arange(width)
             node_G = node_G.at[idx].set(G[:, 0, 0])
             node_H = node_H.at[idx].set(H[:, 0, 0])
@@ -214,7 +199,6 @@ def _build_tree_program(spec: TreeSpec):
                 jnp.where(do_split, best_f, -1))
             split_bin = split_bin.at[idx].set(best_b)
             gains = gains.at[idx].set(jnp.where(do_split, best_gain, 0.0))
-            # --- reassign rows --------------------------------------------
             my_f = best_f[lid_c]
             my_b = best_b[lid_c]
             my_split = do_split[lid_c]
@@ -231,16 +215,145 @@ def _build_tree_program(spec: TreeSpec):
         in_level = (lid >= 0) & (lid < width) & (weight > 0)
         lid_c = jnp.where(in_level, lid, 0)
         wq = jnp.where(in_level, weight, 0.0)
-        lG = jnp.zeros((width,), jnp.float32).at[lid_c].add(grad * wq)
-        lH = jnp.zeros((width,), jnp.float32).at[lid_c].add(hess * wq)
-        lW = jnp.zeros((width,), jnp.float32).at[lid_c].add(wq)
-        lstats = coll.psum(jnp.stack([lG, lH, lW]))
+        node1hot = jax.nn.one_hot(lid_c, width, dtype=jnp.float32) \
+            * (wq > 0)[:, None]
+        lstats = coll.psum(node1hot.T @ jnp.stack(
+            [grad * wq, hess * wq, wq], axis=1))
         idx = base + jnp.arange(width)
-        node_G = node_G.at[idx].set(lstats[0])
-        node_H = node_H.at[idx].set(lstats[1])
-        node_W = node_W.at[idx].set(lstats[2])
+        node_G = node_G.at[idx].set(lstats[:, 0])
+        node_H = node_H.at[idx].set(lstats[:, 1])
+        node_W = node_W.at[idx].set(lstats[:, 2])
         leaf_value = -node_G / (node_H + spec.reg_lambda + 1e-12)
-        return split_feature, split_bin, leaf_value, gains, node_H
+        # empty nodes (zero cover) inherit the parent value so unseen routes
+        # at predict time fall back gracefully; D passes propagate top-down
+        parent = jnp.maximum((jnp.arange(n_nodes) - 1) // 2, 0)
+        for _ in range(D):
+            leaf_value = jnp.where(node_W > 0, leaf_value, leaf_value[parent])
+            split_feature = jnp.where(node_W > 0, split_feature, -1)
+        pack = jnp.stack([split_feature.astype(jnp.float32),
+                          split_bin.astype(jnp.float32),
+                          leaf_value, gains, node_H])
+        return pack
+
+    return build
+
+
+def _traverse(binned, split_feature, split_bin, leaf_value, depth: int):
+    """Vectorized on-device tree traversal (shared by fit-time margin
+    updates and predict)."""
+    node = jnp.zeros((binned.shape[0],), dtype=jnp.int32)
+    for _ in range(depth):
+        f = split_feature[node]
+        b = split_bin[node]
+        is_internal = f >= 0
+        xbin = jnp.take_along_axis(binned, jnp.maximum(f, 0)[:, None],
+                                   axis=1)[:, 0]
+        child = 2 * node + 1 + (xbin > b).astype(jnp.int32)
+        node = jnp.where(is_internal, child, node)
+    return leaf_value[node]
+
+
+class EnsembleSpec(NamedTuple):
+    """Static configuration of a whole-ensemble on-device build."""
+    tree: TreeSpec
+    n_trees: int
+    loss: str           # "squared" | "logistic"
+    boosting: bool
+    bootstrap: bool
+    subsample: float
+    step_size: float
+
+
+_ensemble_cache: Dict[EnsembleSpec, object] = {}
+
+
+def _make_ensemble_program(es: EnsembleSpec):
+    """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
+    trees, margins and sampling weights living in HBM for the entire fit.
+    One dispatch + one packed device→host transfer per ensemble — the
+    per-tree host round-trips (expensive over a TPU tunnel) disappear."""
+    spec = es.tree
+    build = _make_tree_builder(spec)
+    D, B, F = spec.max_depth, spec.n_bins, spec.n_features
+
+    def program(binned, y, mask, rng):
+        n = binned.shape[0]
+        B1 = jax.nn.one_hot(binned, B, dtype=jnp.float32).reshape(n, F * B)
+        key = jax.random.wrap_key_data(rng)
+        # per-chip sampling streams must differ: fold in the shard index
+        key = jax.random.fold_in(key, coll.axis_index())
+        n_tot = coll.psum(jnp.sum(mask))
+        if es.loss == "logistic":
+            p0 = jnp.clip(coll.psum(jnp.sum(y * mask)) / n_tot, 1e-6, 1 - 1e-6)
+            base = jnp.log(p0 / (1 - p0))
+        else:
+            base = coll.psum(jnp.sum(y * mask)) / n_tot
+        margin0 = jnp.full((n,), base, dtype=jnp.float32)
+
+        def round_fn(margin, t):
+            if es.boosting:
+                if es.loss == "logistic":
+                    p = jax.nn.sigmoid(margin)
+                    grad = p - y
+                    hess = jnp.maximum(p * (1 - p), 1e-6)
+                else:
+                    grad = margin - y
+                    hess = jnp.ones_like(y)
+            else:
+                grad = -y
+                hess = jnp.ones_like(y)
+            kt = jax.random.fold_in(key, t)
+            if es.bootstrap and es.n_trees > 1:
+                w = jax.random.poisson(kt, es.subsample, (n,)).astype(jnp.float32)
+            elif es.subsample < 1.0:
+                w = jax.random.bernoulli(kt, es.subsample, (n,)).astype(jnp.float32)
+            else:
+                w = jnp.ones((n,), jnp.float32)
+            w = w * mask
+            feat_rng = jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(rng), t))  # same across chips
+            pack = build(B1, binned, grad, hess, w, feat_rng)
+            if es.boosting:
+                margin = margin + es.step_size * _traverse(
+                    binned, pack[0].astype(jnp.int32),
+                    pack[1].astype(jnp.int32), pack[2], D)
+            return margin, pack
+
+        _, packs = jax.lax.scan(round_fn, margin0, jnp.arange(es.n_trees))
+        return packs, base
+
+    return program
+
+
+def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
+                           seed: int = 0):
+    """Run the whole-ensemble program; returns (trees, base)."""
+    if es not in _ensemble_cache:
+        _ensemble_cache[es] = data_parallel(_make_ensemble_program(es),
+                                            replicated_argnums=(3,))
+    compiled = _ensemble_cache[es]
+    rng = jax.random.key_data(jax.random.PRNGKey(seed))
+    packs, base = compiled(binned_dev, y_dev, mask_dev, rng)
+    packs = np.asarray(packs)      # ONE transfer: (T, 5, n_nodes)
+    trees = [FittedTree(split_feature=p[0].astype(np.int32),
+                        split_bin=p[1].astype(np.int32),
+                        leaf_value=p[2].astype(np.float32),
+                        gain=p[3].astype(np.float32),
+                        cover=p[4].astype(np.float32)) for p in packs]
+    return trees, float(np.asarray(base))
+
+
+def _build_tree_program(spec: TreeSpec):
+    """Single-tree program (kept for the dryrun/compile-check path)."""
+    B, F = spec.n_bins, spec.n_features
+    build = _make_tree_builder(spec)
+
+    def program(binned, grad, hess, weight, feat_rng):
+        n = binned.shape[0]
+        B1 = jax.nn.one_hot(binned, B, dtype=jnp.float32).reshape(n, F * B)
+        pack = build(B1, binned, grad, hess, weight, feat_rng)
+        return (pack[0].astype(jnp.int32), pack[1].astype(jnp.int32),
+                pack[2], pack[3], pack[4])
 
     return program
 

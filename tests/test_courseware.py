@@ -1,0 +1,79 @@
+"""Courseware harness tests (SURVEY §1 L9, §4)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu import courseware as cw
+
+
+def test_classroom_setup_and_datasets(tmp_path):
+    setup = cw.ClassroomSetup(course_name="ml-test", base_dir=str(tmp_path))
+    assert os.path.isdir(setup.working_dir)
+    d = setup.install_datasets()
+    assert os.path.exists(os.path.join(d, "_SUCCESS"))
+    # idempotent: second call is a no-op unless reinstall
+    marker_time = open(os.path.join(d, "_SUCCESS")).read()
+    setup.install_datasets()
+    assert open(os.path.join(d, "_SUCCESS")).read() == marker_time
+    csv = os.path.join(d, "airbnb", "sf-listings",
+                       "sf-listings-2019-03-06.csv")
+    pdf = pd.read_csv(csv)
+    assert "price" in pdf.columns and len(pdf) == 10000
+    assert pdf["neighbourhood_cleansed"].nunique() == 36  # > default maxBins
+    setup.reset()
+    assert os.path.isdir(setup.working_dir)
+
+
+def test_dedup_dataset_shape():
+    pdf = cw.make_dedup_dataset(n=1030, n_unique=1000)
+    assert len(pdf) == 1030
+    # case/format-normalized dedup recovers the unique count (ML 00L)
+    norm = pdf.assign(
+        firstName=pdf["firstName"].str.lower(),
+        ssn=pdf["ssn"].str.replace("-", "", regex=False))
+    assert len(norm.drop_duplicates()) == 1000
+
+
+def test_validation_harness(spark):
+    results = cw.TestResults()
+    h = results.to_hash("42")
+    assert results.validate_your_answer("the answer", h, "42")
+    assert not results.validate_your_answer("wrong", h, "43")
+    df = spark.createDataFrame(pd.DataFrame({"a": [1.0], "b": ["x"]}))
+    assert results.validate_your_schema("schema ok", df,
+                                        {"a": "double", "b": "string"})
+    assert not results.validate_your_schema("schema bad", df, {"a": "string"})
+    html = results.summarize_your_results()
+    assert "passed" in html and "FAILED" in html
+    assert not results.all_passed
+
+
+def test_test_logging(tmp_path):
+    d = str(tmp_path / "grades")
+    cw.log_your_test(d, "RMSE of model", 1.25)
+    cw.log_your_test(d, "R2", 0.9)
+    out = cw.load_your_test_results(d)
+    assert len(out) == 2
+    m = cw.load_your_test_map(d)
+    assert m["RMSE of model"] == 1.25
+
+
+def test_wait_for_model(tmp_path):
+    from sml_tpu import tracking as mlflow
+    mlflow.set_tracking_uri(str(tmp_path / "rt"))
+    from sklearn.linear_model import LinearRegression as SkLR
+    sk = SkLR().fit([[0.0], [1.0]], [0.0, 1.0])
+    with mlflow.start_run():
+        mlflow.sklearn.log_model(sk, "model", registered_model_name="wfm")
+    mv = cw.wait_for_model("wfm", 1, timeout_s=5)
+    assert mv.status == "READY"
+    with pytest.raises(TimeoutError):
+        cw.wait_for_model("missing-model", 1, timeout_s=0.5)
+
+
+def test_fill_in():
+    assert cw.FILL_IN.VALUE is None
+    assert cw.FILL_IN.LIST == []
