@@ -264,6 +264,9 @@ def infer_schema_from_pandas(pdf: pd.DataFrame) -> StructType:
     st = StructType()
     for name in pdf.columns:
         s = pdf[name]
+        if getattr(s.dtype, "name", "") == "vector":  # columnar VectorArray
+            st.add(str(name), VectorType())
+            continue
         kind = s.dtype.kind
         if kind == "f":
             t: DataType = DoubleType() if s.dtype.itemsize > 4 else FloatType()

@@ -23,7 +23,7 @@ import pandas as pd
 
 from .base import Estimator, Model, load_arrays, save_arrays
 from .feature import _as_object_series
-from .linalg import DenseVector
+from .linalg import DenseVector, vector_series
 from ._staging import extract_features, extract_xy
 from . import tree_impl
 from .tree_impl import (Binning, FittedTree, TreeSpec, bin_with,
@@ -241,8 +241,9 @@ class _TreeClassificationModel(_TreeModelBase):
                 p1 = np.clip(m, 0.0, 1.0)
             else:  # boosted margins
                 p1 = 1.0 / (1.0 + np.exp(-m))
-            out[rc] = _as_object_series([DenseVector([1 - p, p]) for p in p1])
-            out[prc] = _as_object_series([DenseVector([1 - p, p]) for p in p1])
+            probs = np.stack([1 - p1, p1], axis=1)
+            out[rc] = vector_series(probs, index=out.index)
+            out[prc] = vector_series(probs.copy(), index=out.index)
             out[oc] = (p1 > 0.5).astype(float)
             return out
 

@@ -16,7 +16,7 @@ import pandas as pd
 
 from .base import Estimator, Model, load_arrays, save_arrays
 from .feature import _as_object_series
-from .linalg import DenseVector
+from .linalg import DenseVector, vector_series
 from ._staging import extract_features, extract_xy
 from . import linear_impl
 from ._tree_models import (DecisionTreeClassificationModel,
@@ -140,8 +140,10 @@ class LogisticRegressionModel(Model):
             X = extract_features(out, fc)
             margin = linear_impl.predict_linear(X, w, b)
             p1 = 1.0 / (1.0 + np.exp(-margin))
-            out[rc] = _as_object_series([DenseVector([-m, m]) for m in margin])
-            out[prc] = _as_object_series([DenseVector([1 - p, p]) for p in p1])
+            out[rc] = vector_series(np.stack([-margin, margin], axis=1),
+                                    index=out.index)
+            out[prc] = vector_series(np.stack([1 - p1, p1], axis=1),
+                                     index=out.index)
             out[pc] = (p1 > thr).astype(float)
             return out
 

@@ -113,7 +113,10 @@ class BinaryClassificationEvaluator(Evaluator):
                     col = alt
                     break
         vals = pdf[col]
-        if len(vals) and hasattr(vals.iloc[0], "toArray"):
+        from .linalg import VectorArray, to_matrix
+        if isinstance(getattr(vals, "array", None), VectorArray):
+            score = to_matrix(vals)[:, -1].astype(np.float64)
+        elif len(vals) and hasattr(vals.iloc[0], "toArray"):
             score = np.asarray([v.toArray()[-1] for v in vals], dtype=np.float64)
         elif len(vals) and isinstance(vals.iloc[0], (list, tuple, np.ndarray)):
             score = np.asarray([v[-1] for v in vals], dtype=np.float64)

@@ -21,7 +21,8 @@ import numpy as np
 import pandas as pd
 
 from .base import Estimator, Model, Transformer
-from .linalg import DenseVector, SparseVector, Vector
+from .linalg import (DenseVector, SparseVector, Vector, VectorArray,
+                     to_matrix, vector_series)
 
 
 def _as_object_series(values: List) -> pd.Series:
@@ -64,17 +65,22 @@ class VectorAssembler(Transformer):
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
             if len(pdf) == 0:
                 out = pdf.copy()
-                out[out_col] = _as_object_series([])
+                out[out_col] = vector_series(np.zeros((0, 0)))
                 return out
             blocks = []
             for c in in_cols:
                 col = pdf[c]
-                if len(col) and isinstance(col.iloc[0], Vector):
+                arr = getattr(col, "array", None)
+                if isinstance(arr, VectorArray):
+                    blocks.append(arr.block)   # columnar: no per-row objects
+                elif len(col) and isinstance(col.iloc[0], Vector):
                     blocks.append(np.stack([v.toArray() for v in col]))
                 else:
                     blocks.append(np.asarray(pd.to_numeric(col, errors="coerce"),
                                              dtype=np.float64)[:, None])
-            mat = np.concatenate(blocks, axis=1)
+            # single-input case must not alias the input column's block
+            mat = np.concatenate(blocks, axis=1) if len(blocks) > 1 \
+                else blocks[0].copy()
             bad = ~np.isfinite(mat).all(axis=1)
             out = pdf.copy()
             if bad.any():
@@ -85,7 +91,7 @@ class VectorAssembler(Transformer):
                 if invalid == "skip":
                     out = out[~bad].reset_index(drop=True)
                     mat = mat[~bad]
-            out[out_col] = _as_object_series([DenseVector(r) for r in mat])
+            out[out_col] = vector_series(mat, index=out.index)
             return out
 
         res = df._derive(fn)
@@ -97,15 +103,18 @@ class VectorAssembler(Transformer):
         for c in in_cols:
             width = 1
             attrs = df._ml_attrs.get(c)
-            if attrs is None:
+            if attrs is not None and "categorical" in attrs:
+                slots[pos] = int(attrs["categorical"])
+            elif attrs is not None and "numFeatures" in attrs:
+                # previously-assembled vector column: attrs carry its width
+                width = int(attrs["numFeatures"])
+            else:
                 # vector input columns occupy their own width; peek one row
                 if pdf0 is None:
                     pdf0 = df.limit(1).toPandas()
                 v = pdf0[c].iloc[0] if len(pdf0) else None
                 if isinstance(v, Vector):
                     width = v.size
-            elif "categorical" in attrs:
-                slots[pos] = int(attrs["categorical"])
             pos += width
         res._ml_attrs[out_col] = {"slots": slots, "numFeatures": pos}
         return res
@@ -292,17 +301,15 @@ class OneHotEncoderModel(Model):
             out = pdf.copy()
             for c, oc, size in zip(in_cols, out_cols, sizes):
                 width = size - 1 if drop_last else size
-                vecs = []
-                for v in pd.to_numeric(out[c], errors="coerce"):
-                    if pd.isna(v):
-                        vecs.append(None)
-                        continue
-                    i = int(v)
-                    if i < width:
-                        vecs.append(SparseVector(width, [i], [1.0]))
-                    else:  # dropped last category (or overflow w/ keep)
-                        vecs.append(SparseVector(width, [], []))
-                out[oc] = _as_object_series(vecs)
+                idx = pd.to_numeric(out[c], errors="coerce").to_numpy(dtype=np.float64)
+                na = ~np.isfinite(idx)
+                block = np.zeros((len(idx), width))
+                ok = ~na & (idx >= 0) & (idx < width)  # dropped-last → all-zero row
+                block[np.nonzero(ok)[0], idx[ok].astype(np.intp)] = 1.0
+                block[na] = np.nan
+                # columnar one-hot: dense (n, width) block; elements
+                # materialize as SparseVector on access for MLlib parity
+                out[oc] = vector_series(block, index=out.index, sparse=True, na=na)
             return out
 
         return df._derive(fn)
@@ -427,15 +434,14 @@ class StandardScalerModel(Model):
 
         def fn(pdf, ctx):
             out = pdf.copy()
-            vecs = []
-            for v in out[ic]:
-                arr = v.toArray().astype(np.float64)
-                if with_mean:
-                    arr = arr - mean
-                if with_std:
-                    arr = arr / std
-                vecs.append(DenseVector(arr))
-            out[oc] = _as_object_series(vecs)
+            X = to_matrix(out[ic])   # zero-copy for columnar vector columns
+            if with_mean:
+                X = X - mean
+            if with_std:
+                X = X / std
+            elif not with_mean:
+                X = X.copy()
+            out[oc] = vector_series(X, index=out.index)
             return out
 
         return df._derive(fn)

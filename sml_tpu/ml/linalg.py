@@ -10,7 +10,7 @@ types exist for API parity and host-side inspection, never for device math.
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence, Union
+from typing import Iterable, Optional, Sequence, Union
 
 import numpy as np
 
@@ -127,7 +127,17 @@ class Vectors:
 
 def to_matrix(col: Sequence[Union[Vector, Sequence[float]]]) -> np.ndarray:
     """Densify a host column of vectors into an (n, d) float64 matrix — the
-    staging boundary before `parallel.mesh.shard_rows` ships it to HBM."""
+    staging boundary before `parallel.mesh.shard_rows` ships it to HBM.
+
+    Columnar `VectorArray`-backed Series return their backing block with NO
+    per-row work (the hot path: VERDICT r1 flagged the per-row
+    `v.toArray()` loop as the framework's bottleneck)."""
+    import pandas as pd
+
+    if isinstance(col, VectorArray):
+        return col.block
+    if isinstance(col, pd.Series) and isinstance(col.array, VectorArray):
+        return col.array.block
     n = len(col)
     if n == 0:
         return np.zeros((0, 0))
@@ -137,3 +147,186 @@ def to_matrix(col: Sequence[Union[Vector, Sequence[float]]]) -> np.ndarray:
     for i, v in enumerate(col):
         out[i] = v.toArray() if isinstance(v, Vector) else np.asarray(v, dtype=np.float64)
     return out
+
+
+# ===================================================================== columnar
+# A pandas ExtensionArray holding the whole vector column as ONE dense
+# (n, d) float64 block. This is the Arrow-FixedSizeList role from
+# `SML/ML 12 - Inference with Pandas UDFs.py:64` (zero-copy columnar
+# interchange): the ML layer stages `col.array.block` straight to HBM, and
+# per-row Vector objects exist only when an element is actually inspected.
+
+from pandas.api.extensions import (ExtensionArray, ExtensionDtype,  # noqa: E402
+                                   register_extension_dtype)
+import pandas as pd  # noqa: E402
+
+
+@register_extension_dtype
+class VectorDtype(ExtensionDtype):
+    name = "vector"
+    type = Vector
+    kind = "O"
+    na_value = None
+
+    @classmethod
+    def construct_array_type(cls):
+        return VectorArray
+
+    @classmethod
+    def construct_from_string(cls, string):
+        if string == cls.name:
+            return cls()
+        raise TypeError(f"cannot construct VectorDtype from {string!r}")
+
+
+class VectorArray(ExtensionArray):
+    """Column of vectors backed by a single dense (n, d) block.
+
+    `sparse=True` marks columns whose elements should materialize as
+    SparseVector (OneHotEncoder output parity with MLlib); the backing
+    storage is dense either way — one-hot widths in the course are tiny and
+    a dense block is what the MXU wants. NA elements are a True in `_na`
+    and a NaN row in the block (so finite-ness checks see them naturally).
+    """
+
+    def __init__(self, block: np.ndarray, na: Optional[np.ndarray] = None,
+                 sparse: bool = False, copy: bool = False):
+        block = np.asarray(block, dtype=np.float64)
+        if block.ndim != 2:
+            raise ValueError(f"VectorArray needs (n, d) block, got {block.shape}")
+        if copy:
+            block = block.copy()
+        self._block = block
+        self._na = (np.zeros(len(block), dtype=bool) if na is None
+                    else np.asarray(na, dtype=bool))
+        self._sparse = bool(sparse)
+
+    # -- block access (the point of this class) ---------------------------
+    @property
+    def block(self) -> np.ndarray:
+        return self._block
+
+    @property
+    def width(self) -> int:
+        return int(self._block.shape[1])
+
+    # -- pandas EA interface ----------------------------------------------
+    @property
+    def dtype(self):
+        return VectorDtype()
+
+    def __len__(self):
+        return len(self._block)
+
+    @property
+    def nbytes(self):
+        return self._block.nbytes + self._na.nbytes
+
+    def _make_scalar(self, row: np.ndarray):
+        if self._sparse:
+            nz = np.nonzero(row)[0]
+            return SparseVector(len(row), nz.astype(np.int32), row[nz])
+        return DenseVector(row)
+
+    def __getitem__(self, key):
+        if isinstance(key, (int, np.integer)):
+            if self._na[key]:
+                return None
+            return self._make_scalar(self._block[int(key)])
+        if isinstance(key, slice):
+            return VectorArray(self._block[key], self._na[key], self._sparse)
+        key = np.asarray(key)
+        if key.dtype == bool:
+            return VectorArray(self._block[key], self._na[key], self._sparse)
+        return self.take(key)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def isna(self):
+        return self._na.copy()
+
+    def take(self, indices, allow_fill: bool = False, fill_value=None):
+        indices = np.asarray(indices, dtype=np.intp)
+        if allow_fill:
+            na = indices == -1
+            safe = np.where(na, 0, indices)
+            if len(self._block) == 0 and na.all():
+                block = np.full((len(indices), self.width), np.nan)
+                return VectorArray(block, np.ones(len(indices), bool), self._sparse)
+            block = self._block[safe].copy()
+            block[na] = np.nan
+            return VectorArray(block, self._na[safe] | na, self._sparse)
+        return VectorArray(self._block[indices], self._na[indices], self._sparse)
+
+    def copy(self):
+        return VectorArray(self._block.copy(), self._na.copy(), self._sparse)
+
+    @classmethod
+    def _from_sequence(cls, scalars, *, dtype=None, copy=False):
+        if isinstance(scalars, VectorArray):
+            return scalars.copy() if copy else scalars
+        vals = list(scalars)
+        na = np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                       for v in vals], dtype=bool)
+        d = 0
+        sparse = False
+        for v in vals:
+            if isinstance(v, Vector):
+                d = v.size
+                sparse = isinstance(v, SparseVector)
+                break
+            if isinstance(v, (list, tuple, np.ndarray)):
+                d = len(v)
+                break
+        block = np.full((len(vals), d), np.nan)
+        for i, v in enumerate(vals):
+            if na[i]:
+                continue
+            block[i] = v.toArray() if isinstance(v, Vector) else \
+                np.asarray(v, dtype=np.float64)
+        return cls(block, na, sparse)
+
+    @classmethod
+    def _concat_same_type(cls, to_concat):
+        arrs = list(to_concat)
+        widths = {a.width for a in arrs if len(a)}
+        if len(widths) > 1:
+            raise ValueError(f"cannot concat vector columns of widths {widths}")
+        d = widths.pop() if widths else (arrs[0].width if arrs else 0)
+        blocks = [a.block if len(a) else np.zeros((0, d)) for a in arrs]
+        nas = [a._na for a in arrs]
+        sparse = any(a._sparse for a in arrs)
+        return cls(np.concatenate(blocks, axis=0) if blocks else np.zeros((0, d)),
+                   np.concatenate(nas) if nas else None, sparse)
+
+    def _values_for_factorize(self):
+        return np.asarray(self.astype(object)), None
+
+    def astype(self, dtype, copy: bool = True):
+        if isinstance(dtype, VectorDtype):
+            return self.copy() if copy else self
+        dtype = np.dtype(dtype) if not isinstance(dtype, ExtensionDtype) else dtype
+        if dtype == np.dtype(object):
+            out = np.empty(len(self), dtype=object)
+            for i in range(len(self)):
+                out[i] = self[i]
+            return out
+        return super().astype(dtype, copy=copy)
+
+    def __eq__(self, other):  # elementwise, pandas semantics
+        if isinstance(other, VectorArray):
+            return np.all(self._block == other._block, axis=1) & \
+                ~self._na & ~other._na
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else ~eq
+
+
+def vector_series(block: np.ndarray, index=None, sparse: bool = False,
+                  na: Optional[np.ndarray] = None) -> "pd.Series":
+    """Wrap an (n, d) block as a columnar vector Series."""
+    return pd.Series(VectorArray(block, na=na, sparse=sparse), index=index)

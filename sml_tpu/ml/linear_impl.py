@@ -73,7 +73,10 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
         # penalty back to raw space; intercept row/col unpenalized
         reg = np.zeros_like(A)
         if lam > 0:
-            scale = (1.0 / std ** 2) if standardization else np.ones(d)
+            # penalizing standardized coefficients (w_std = w·std) puts a
+            # λ·std² diagonal on the raw-space normal equations — same
+            # semantics as the FISTA branch below
+            scale = (std ** 2) if standardization else np.ones(d)
             reg[:d, :d] = np.diag(lam * n_f * scale)
         if not fitIntercept:
             A = A[:d, :d]
@@ -133,14 +136,21 @@ def _newton_pass(Xb, yb, mask, wb):
 
 def fit_logistic(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
                  elasticNetParam: float = 0.0, fitIntercept: bool = True,
-                 maxIter: int = 100, tol: float = 1e-7) -> LinearFit:
+                 standardization: bool = True, maxIter: int = 100,
+                 tol: float = 1e-7) -> LinearFit:
     """Binomial logistic regression by IRLS Newton steps; the per-iteration
     `X^T W X` / gradient reduction is a psum over the mesh — the exact shape
-    of MLlib's treeAggregate-per-iteration loop."""
+    of MLlib's treeAggregate-per-iteration loop. As with fit_linear, the
+    default penalty applies to standardized coefficients (reference's
+    standardization=True), i.e. a per-feature std² scale in raw space."""
     n, d = X.shape
     lam = float(regParam)
     l2 = lam * (1 - float(elasticNetParam))
     l1 = lam * float(elasticNetParam)
+    if standardization and lam > 0:
+        pen_scale = np.maximum(X.astype(np.float64).var(axis=0), 1e-12)
+    else:
+        pen_scale = np.ones(d)
 
     w = np.zeros(d + 1, dtype=np.float32)
     n_f = float(len(y))
@@ -153,14 +163,16 @@ def fit_logistic(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
         grad = np.asarray(grad, dtype=np.float64)
         hess = np.asarray(hess, dtype=np.float64)
         if l2 > 0:
-            grad[:d] += l2 * n_f * w[:d]
-            hess[:d, :d] += l2 * n_f * np.eye(d)
+            grad[:d] += l2 * n_f * pen_scale * w[:d]
+            hess[:d, :d] += l2 * n_f * np.diag(pen_scale)
         step = np.linalg.solve(hess + 1e-8 * np.eye(d + 1), grad)
         w_new = w - step.astype(np.float32)
         if l1 > 0:  # proximal shrink on coefficients (not intercept)
+            # standardized L1 is λα·Σ σ_j|w_j| in raw space — linear in σ,
+            # unlike the quadratic L2 term's σ²
             scale = np.abs(np.diag(hess)[:d]) + 1e-12
             w_new[:d] = np.sign(w_new[:d]) * np.maximum(
-                np.abs(w_new[:d]) - l1 * n_f / scale, 0.0)
+                np.abs(w_new[:d]) - l1 * n_f * np.sqrt(pen_scale) / scale, 0.0)
         iters = it + 1
         if np.max(np.abs(w_new - w)) < tol:
             w = w_new

@@ -63,17 +63,24 @@ class Prophet:
             cols += [np.sin(arg), np.cos(arg)]
         return np.stack(cols, axis=1) if cols else np.zeros((len(t_days), 0))
 
-    def _season_blocks(self, ds: pd.Series) -> Dict[str, np.ndarray]:
+    def _season_blocks(self, ds: pd.Series,
+                       force: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        """Build seasonality design blocks. At fit time the 'auto' gates
+        resolve against the training span; at predict time `force` carries
+        the fitted block names so a future-only/short frame produces exactly
+        the columns the weight vector was fitted on."""
         t_days = ((ds - self._t_start).dt.total_seconds() / 86400.0).values
         span_days = t_days.max() - t_days.min() if len(t_days) else 0
+        on = (lambda name, flag, gate: name in force) if force is not None \
+            else (lambda name, flag, gate: (flag is True) or (flag == "auto" and gate))
         blocks: Dict[str, np.ndarray] = {}
-        if (self.yearly is True) or (self.yearly == "auto" and span_days >= 2 * 365):
+        if on("yearly", self.yearly, span_days >= 2 * 365):
             blocks["yearly"] = self._fourier(t_days, 365.25, 10)
-        if (self.weekly is True) or (self.weekly == "auto" and span_days >= 14):
+        if on("weekly", self.weekly, span_days >= 14):
             blocks["weekly"] = self._fourier(t_days, 7.0, 3)
-        if (self.daily is True):
+        if on("daily", self.daily, False):
             blocks["daily"] = self._fourier(t_days, 1.0, 4)
-        if self.holidays is not None:
+        if (self.holidays is not None if force is None else "holidays" in force):
             hd = pd.to_datetime(self.holidays["ds"]).dt.normalize()
             flag = ds.dt.normalize().isin(set(hd)).astype(float).values[:, None]
             blocks["holidays"] = flag
@@ -156,9 +163,8 @@ class Prophet:
         ds = pd.to_datetime(df["ds"]).reset_index(drop=True)
         t = self._scale_t(ds)
         T = self._trend_matrix(t)
-        blocks = self._season_blocks(ds)
-        parts = [T] + [blocks.get(bn, np.zeros((len(ds), 0)))
-                       for bn in self._block_names]
+        blocks = self._season_blocks(ds, force=self._block_names)
+        parts = [T] + [blocks[bn] for bn in self._block_names]
         X = np.concatenate(parts, axis=1)
         yn = X @ self._w
         trend_n = T @ self._w[:self._n_trend]
@@ -173,7 +179,7 @@ class Prophet:
         })
         col_off = self._n_trend
         for bn in self._block_names:
-            width = blocks[bn].shape[1] if bn in blocks else 0
+            width = blocks[bn].shape[1]
             comp = blocks[bn] @ self._w[col_off:col_off + width] if width else 0.0
             out[bn] = np.asarray(comp) * self._y_scale
             col_off += width
