@@ -328,10 +328,12 @@ def _make_ensemble_program(es: EnsembleSpec):
 def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                            seed: int = 0):
     """Run the whole-ensemble program; returns (trees, base)."""
-    if es not in _ensemble_cache:
-        _ensemble_cache[es] = data_parallel(_make_ensemble_program(es),
-                                            replicated_argnums=(3,))
-    compiled = _ensemble_cache[es]
+    from ..parallel import mesh as _meshlib
+    key = (es, id(_meshlib.get_mesh()))  # programs are mesh-specific
+    if key not in _ensemble_cache:
+        _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
+                                             replicated_argnums=(3,))
+    compiled = _ensemble_cache[key]
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
     packs, base = compiled(binned_dev, y_dev, mask_dev, rng)
     packs = np.asarray(packs)      # ONE transfer: (T, 5, n_nodes)
@@ -364,10 +366,12 @@ _tree_cache: Dict[TreeSpec, object] = {}
 def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
              rng: int = 0, feat_key: Optional[np.ndarray] = None) -> FittedTree:
     """Build one tree on the mesh from pre-staged device arrays."""
-    if spec not in _tree_cache:
-        _tree_cache[spec] = data_parallel(_build_tree_program(spec),
-                                          replicated_argnums=(4,))
-    compiled = _tree_cache[spec]
+    from ..parallel import mesh as _meshlib
+    key = (spec, id(_meshlib.get_mesh()))  # programs are mesh-specific
+    if key not in _tree_cache:
+        _tree_cache[key] = data_parallel(_build_tree_program(spec),
+                                         replicated_argnums=(4,))
+    compiled = _tree_cache[key]
     if feat_key is None:
         feat_key = jax.random.key_data(jax.random.PRNGKey(rng))
     sf, sb, lv, g, cov = compiled(binned_dev, grad_dev, hess_dev, weight_dev,

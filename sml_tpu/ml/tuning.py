@@ -6,21 +6,23 @@ Tuning.py:72-158`): `ParamGridBuilder().addGrid(...).build()`,
 parallelism=4, seed=42)` with `avgMetrics`/`bestModel`, and both stage
 orders (CV-inside-pipeline vs pipeline-inside-CV, `ML 07:134-149`).
 
-Parallelism: trials dispatch on a thread pool of width `parallelism`
-(the reference's driver thread pool, `ML 07:120-130`); each trial's device
-programs are serialized by XLA per-chip, so threads overlap host-side work
-(staging, binning, metric assembly) with device compute — the task-parallel
-model-selection strategy SURVEY §2.2 P6.
+Parallelism: trials run `parallelism`-wide with REAL chip placement — the
+active mesh is partitioned into disjoint per-worker submeshes
+(`parallel.mesh.run_placed_trials`), so concurrent fits execute on
+different chips instead of serializing device programs on one shared mesh.
+This is the TPU form of the reference's driver thread pool + executor
+tasks (`ML 07:120-130`) — the task-parallel model-selection strategy
+SURVEY §2.2 P6.
 """
 
 from __future__ import annotations
 
 import itertools
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..parallel.mesh import run_placed_trials
 from .base import Estimator, Model, Saveable
 from .param import Param
 
@@ -105,11 +107,7 @@ class CrossValidator(Estimator, _ValidatorParams):
             gi, fi, train, val, pmap = job
             return gi, fi, _fit_and_eval(est, pmap, train, val, evaluator)
 
-        if par == 1:
-            results = [run(j) for j in jobs]
-        else:
-            with ThreadPoolExecutor(max_workers=par) as pool:
-                results = list(pool.map(run, jobs))
+        results = run_placed_trials(jobs, run, par)
         for gi, fi, m in results:
             metrics[gi, fi] = m
 
@@ -175,11 +173,7 @@ class TrainValidationSplit(Estimator, _ValidatorParams):
         def run(pmap):
             return _fit_and_eval(est, pmap, train, val, evaluator)
 
-        if par == 1:
-            metrics = [run(p) for p in grid]
-        else:
-            with ThreadPoolExecutor(max_workers=par) as pool:
-                metrics = list(pool.map(run, grid))
+        metrics = run_placed_trials(grid, run, par)
         arr = np.asarray(metrics)
         best_idx = int(np.argmax(arr) if evaluator.isLargerBetter()
                        else np.argmin(arr))

@@ -1,5 +1,6 @@
 from . import collectives, mesh
 from .mesh import (DATA_AXIS, MODEL_AXIS, build_mesh, data_sharding, get_mesh,
+                   submeshes, use_mesh_local,
                    mesh_device_count, pad_rows, replicated, row_mask,
                    set_mesh, shard_rows, use_mesh)
 
@@ -7,4 +8,5 @@ __all__ = [
     "collectives", "mesh", "DATA_AXIS", "MODEL_AXIS", "build_mesh",
     "data_sharding", "get_mesh", "mesh_device_count", "pad_rows",
     "replicated", "row_mask", "set_mesh", "shard_rows", "use_mesh",
+    "submeshes", "use_mesh_local",
 ]

@@ -23,6 +23,7 @@ MODEL_AXIS = "model"  # feature/block parallelism (Gram blocks, ALS factors)
 
 _lock = threading.RLock()
 _active_mesh: Optional[Mesh] = None
+_tls = threading.local()  # per-thread mesh override (trial placement)
 
 
 def build_mesh(
@@ -49,7 +50,12 @@ def build_mesh(
 
 
 def get_mesh() -> Mesh:
-    """Return the active mesh, building a default 1-D mesh on first use."""
+    """Return the active mesh: the calling thread's override if one is set
+    (per-trial submesh placement), else the process-wide mesh (built lazily
+    as a 1-D mesh over all devices)."""
+    local = getattr(_tls, "mesh", None)
+    if local is not None:
+        return local
     global _active_mesh
     with _lock:
         if _active_mesh is None:
@@ -75,6 +81,49 @@ def use_mesh(mesh: Mesh) -> Iterator[Mesh]:
     finally:
         with _lock:
             _active_mesh = prev
+
+
+@contextlib.contextmanager
+def use_mesh_local(mesh: Optional[Mesh]) -> Iterator[Optional[Mesh]]:
+    """Bind a mesh to the CURRENT THREAD only — the placement mechanism for
+    task-parallel trials (SURVEY §2.2 P6/P7): each trial worker binds its
+    own submesh so concurrent fits land on disjoint chips instead of
+    serializing on one shared mesh."""
+    prev = getattr(_tls, "mesh", None)
+    _tls.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _tls.mesh = prev
+
+
+_submesh_cache: dict = {}
+
+
+def submeshes(k: int, mesh: Optional[Mesh] = None) -> list:
+    """Partition the mesh's devices into min(k, n_devices) disjoint 1-D
+    data-axis submeshes (cycled to length k when k > n_devices). Memoized so
+    repeated tuning fits reuse identical Mesh objects and hit the per-mesh
+    program caches instead of recompiling."""
+    mesh = mesh or get_mesh()
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    k = max(1, int(k))
+    groups = min(k, n)
+    key = (tuple(id(d) for d in devices), groups)
+    if key not in _submesh_cache:
+        per = n // groups
+        extra = n % groups
+        out = []
+        start = 0
+        for g in range(groups):
+            size = per + (1 if g < extra else 0)
+            out.append(Mesh(np.asarray(devices[start:start + size]),
+                            (DATA_AXIS,)))
+            start += size
+        _submesh_cache[key] = out
+    cached = _submesh_cache[key]
+    return [cached[i % groups] for i in range(k)]
 
 
 def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
@@ -123,3 +172,29 @@ def row_mask(n_padded: int, n_true: int, dtype=np.float32) -> np.ndarray:
 def mesh_device_count(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or get_mesh()
     return math.prod(mesh.devices.shape)
+
+
+def run_placed_trials(jobs: Sequence, fn, parallelism: int) -> list:
+    """Run `fn(job)` for every job with REAL chip placement: `parallelism`
+    worker threads, each bound (thread-locally) to its own disjoint submesh
+    of the active mesh, so concurrent trials execute on different chips —
+    the TPU replacement for Spark's driver thread pool + executor tasks
+    (`SML/ML 07:120-130`, `SML/Labs/ML 08L:89-107`)."""
+    jobs = list(jobs)
+    parallelism = max(1, int(parallelism))
+    if parallelism <= 1 or len(jobs) <= 1:
+        return [fn(j) for j in jobs]
+    from concurrent.futures import ThreadPoolExecutor
+    import queue as _queue
+
+    meshes = submeshes(parallelism)
+    q: _queue.SimpleQueue = _queue.SimpleQueue()
+    for m in meshes:
+        q.put(m)
+
+    def bind_submesh():
+        _tls.mesh = q.get_nowait()
+
+    with ThreadPoolExecutor(max_workers=parallelism,
+                            initializer=bind_submesh) as pool:
+        return list(pool.map(fn, jobs))

@@ -20,7 +20,6 @@ and take the candidate maximizing the good/bad density ratio.
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -207,11 +206,14 @@ def fmin(fn: Callable, space: Dict[str, Dimension], algo=None,
         while len(trials) < max_evals:
             run_one(suggest(space, trials, rstate))
     else:
-        with ThreadPoolExecutor(max_workers=width) as pool:
-            while len(trials) < max_evals:
-                batch = min(width, max_evals - len(trials))
-                # batch proposals draw from the same posterior; rng state
-                # advances per proposal so the batch is diverse
-                proposals = [suggest(space, trials, rstate) for _ in range(batch)]
-                list(pool.map(run_one, proposals))
+        from ..parallel.mesh import run_placed_trials
+        while len(trials) < max_evals:
+            batch = min(width, max_evals - len(trials))
+            # batch proposals draw from the same posterior; rng state
+            # advances per proposal so the batch is diverse
+            proposals = [suggest(space, trials, rstate) for _ in range(batch)]
+            # each worker thread is bound to its own submesh of the chip
+            # pool — trials training JAX models land on disjoint chips
+            # (SparkTrials' trial→executor placement, SURVEY P7)
+            run_placed_trials(proposals, run_one, batch)
     return trials.argmin

@@ -58,3 +58,7 @@ def airbnb_pdf():
 @pytest.fixture()
 def airbnb_df(spark, airbnb_pdf):
     return spark.createDataFrame(airbnb_pdf)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running perf/scale tests")
