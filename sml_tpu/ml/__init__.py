@@ -2,7 +2,8 @@
 the device mesh (SURVEY §1 L3; build plan §7 stages 4-6)."""
 
 from .base import Estimator, Model, Pipeline, PipelineModel, Transformer, load_native
+from .inference import DeviceScorer
 from .param import Param, Params
 
 __all__ = ["Estimator", "Model", "Pipeline", "PipelineModel", "Transformer",
-           "Param", "Params", "load_native"]
+           "Param", "Params", "load_native", "DeviceScorer"]

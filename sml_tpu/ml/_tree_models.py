@@ -83,8 +83,27 @@ class _EnsembleSpec:
         self.n_features = n_features
         self.mode = mode  # "regression" | "binary"
 
+    def stacked(self):
+        """Stacked (T, n_nodes) tree tensors + per-tree weights, cached —
+        the replicated operands of the sharded traversal program."""
+        if not hasattr(self, "_stacked"):
+            sf = np.stack([t.split_feature for t in self.trees])
+            sb = np.stack([t.split_bin for t in self.trees])
+            lv = np.stack([t.leaf_value for t in self.trees])
+            w = (np.full(len(self.trees), 1.0 / len(self.trees), np.float32)
+                 if self.tree_weights is None
+                 else np.asarray(self.tree_weights, dtype=np.float32))
+            self._stacked = (sf, sb, lv, w)
+        return self._stacked
+
     def predict_margin(self, X: np.ndarray) -> np.ndarray:
         binned = bin_with(X, self.binning)
+        if binned.shape[0] >= 4096:
+            # rows shard over the mesh; tree tensors replicate (P8 path)
+            from .inference import predict_forest_sharded
+            sf, sb, lv, w = self.stacked()
+            return predict_forest_sharded(binned, sf, sb, lv, w, self.depth,
+                                          base=self.base)
         return self.base + predict_forest(binned, self.trees, self.depth,
                                           self.tree_weights)
 

@@ -1,0 +1,214 @@
+"""Mesh-sharded batch inference — the TPU pandas-UDF path (SURVEY §2.2 P8).
+
+The reference's pandas-UDF lesson is about inference THROUGHPUT
+(`SML/ML 12 - Inference with Pandas UDFs.py:56-61`): Arrow batches stream
+into a Python worker that predicts with a once-loaded model. Here the same
+shape runs on the chip mesh: feature blocks stage into HBM sharded by rows
+over the data axis, and a cached jitted program (linear forward or stacked
+vmapped tree traversal) computes predictions on-device. `DeviceScorer` is
+the load-once object the scalar-iterator UDF pattern amortizes
+(`ML 12:101-112`); async dispatch pipelines batch i+1's staging under
+batch i's compute.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import mesh as meshlib
+from ._staging import cached_data_parallel, extract_features
+from ..parallel import collectives as coll
+
+
+# ------------------------------------------------------------- device programs
+def _linear_forward(Xb, mask, w, b):
+    return (Xb @ w + b) * mask
+
+
+def _logistic_forward(Xb, mask, w, b):
+    return jax.nn.sigmoid(Xb @ w + b) * mask
+
+
+def _make_forest_forward(depth: int):
+    def forest_forward(binned_b, mask, sf, sb, lv, weights):
+        def one_tree(f, s, v):
+            node = jnp.zeros((binned_b.shape[0],), dtype=jnp.int32)
+            for _ in range(depth):
+                feat = f[node]
+                thr = s[node]
+                xbin = jnp.take_along_axis(
+                    binned_b, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+                child = 2 * node + 1 + (xbin > thr).astype(jnp.int32)
+                node = jnp.where(feat >= 0, child, node)
+            return v[node]
+
+        per_tree = jax.vmap(one_tree)(sf, sb, lv)      # (T, rows/chip)
+        return jnp.tensordot(weights, per_tree, axes=1) * mask
+
+    return forest_forward
+
+
+_forest_programs: dict = {}
+
+
+def _forest_program(depth: int):
+    mesh = meshlib.get_mesh()
+    key = (depth, id(mesh))
+    if key not in _forest_programs:
+        _forest_programs[key] = cached_data_parallel(
+            _make_forest_forward(depth), out_replicated=False,
+            replicated_argnums=(2, 3, 4, 5))
+    return _forest_programs[key]
+
+
+def _stage_rows(X: np.ndarray):
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    padded, n_true = meshlib.pad_rows(np.asarray(X), n_dev)
+    dev = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    mask = meshlib.row_mask(padded.shape[0], n_true)
+    mask_dev = jax.device_put(mask, meshlib.data_sharding(mesh, 1))
+    return dev, mask_dev, n_true
+
+
+def predict_linear_sharded(X: np.ndarray, w: np.ndarray, b: float,
+                           *, logistic: bool = False) -> np.ndarray:
+    """Rows sharded over the mesh, coefficients replicated; returns host
+    predictions for the true (unpadded) rows."""
+    Xd, mask, n = _stage_rows(np.ascontiguousarray(X, dtype=np.float32))
+    fwd = _logistic_forward if logistic else _linear_forward
+    prog = cached_data_parallel(fwd, out_replicated=False,
+                                replicated_argnums=(2, 3))
+    out = prog(Xd, mask, jnp.asarray(w, dtype=jnp.float32),
+               jnp.float32(b))
+    return np.asarray(out, dtype=np.float64)[:n]
+
+
+def predict_forest_sharded(binned: np.ndarray, sf: np.ndarray,
+                           sb: np.ndarray, lv: np.ndarray,
+                           weights: np.ndarray, depth: int,
+                           base: float = 0.0) -> np.ndarray:
+    """Stacked-ensemble traversal: rows sharded over the mesh, tree tensors
+    replicated (they are KB-scale), one fused program for the whole forest."""
+    Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, dtype=np.int32))
+    prog = _forest_program(depth)
+    out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
+               jnp.asarray(lv, dtype=jnp.float32),
+               jnp.asarray(weights, dtype=jnp.float32))
+    return base + np.asarray(out, dtype=np.float64)[:n]
+
+
+# ----------------------------------------------------------------- DeviceScorer
+class DeviceScorer:
+    """Load-once, score-many wrapper for native models — the object an
+    ML 12-style scalar-iterator UDF or `mapInPandas` body holds
+    (`ML 12:101-143`): feature prep runs per batch on host, the model math
+    runs as one sharded device program per batch.
+
+    Accepts LinearRegressionModel / LogisticRegressionModel, the tree
+    ensemble models, or a PipelineModel ending in one of those (earlier
+    stages are applied as host feature prep).
+    """
+
+    def __init__(self, model):
+        self._stages = []
+        tail = model
+        stages = getattr(model, "stages", None)
+        if stages:
+            self._stages = list(stages[:-1])
+            tail = stages[-1]
+        self._model = tail
+        self._kind, self._params = self._compile_target(tail)
+
+    @staticmethod
+    def _compile_target(model):
+        spec = getattr(model, "_spec", None)
+        if spec is not None and hasattr(spec, "trees"):  # tree ensembles
+            sf, sb, lv, w = spec.stacked()
+            return "forest", (spec, sf, sb, lv, w)
+        coef = getattr(model, "_coefficients", None)
+        if coef is None and hasattr(model, "coefficients"):
+            coef = np.asarray(model.coefficients.toArray())
+        if coef is not None:
+            intercept = float(getattr(model, "intercept", 0.0))
+            logistic = hasattr(model, "numClasses")
+            return "linear", (np.asarray(coef), intercept, logistic)
+        raise TypeError(f"no device inference path for {type(model).__name__}")
+
+    @property
+    def featuresCol(self) -> str:
+        return self._model.getOrDefault("featuresCol")
+
+    def _dispatch(self, X: np.ndarray):
+        """Stage + launch the device program; returns (device_out, n_true,
+        finalize) without forcing the result — the pipelining hook."""
+        if self._kind == "linear":
+            w, b, logistic = self._params
+            Xd, mask, n = _stage_rows(np.ascontiguousarray(X, np.float32))
+            fwd = _logistic_forward if logistic else _linear_forward
+            prog = cached_data_parallel(fwd, out_replicated=False,
+                                        replicated_argnums=(2, 3))
+            out = prog(Xd, mask, jnp.asarray(w, dtype=jnp.float32),
+                       jnp.float32(b))
+            return out, n, lambda m: m
+
+        spec, sf, sb, lv, w = self._params
+        from .tree_impl import bin_with
+        binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
+        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, np.int32))
+        prog = _forest_program(spec.depth)
+        out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
+                   jnp.asarray(lv, dtype=jnp.float32),
+                   jnp.asarray(w, dtype=jnp.float32))
+
+        def finalize(margin):
+            margin = spec.base + margin
+            if spec.mode == "binary":
+                # boosted margins → sigmoid; probability-leaf forests → clip
+                if spec.tree_weights is not None:
+                    return 1.0 / (1.0 + np.exp(-margin))
+                return np.clip(margin, 0.0, 1.0)
+            return margin
+
+        return out, n, finalize
+
+    def score_block(self, X: np.ndarray) -> np.ndarray:
+        """Predict from a raw (n, d) feature block."""
+        out, n, finalize = self._dispatch(X)
+        return finalize(np.asarray(out, dtype=np.float64)[:n])
+
+    def __call__(self, pdf) -> np.ndarray:
+        """Predict from a host pandas batch: run feature stages, extract
+        the columnar feature block, score on-device."""
+        return self.score_block(self._prep(pdf))
+
+    def _prep(self, pdf) -> np.ndarray:
+        if isinstance(pdf, np.ndarray):
+            return pdf
+        from ..frame.session import get_session
+        cur = pdf
+        if self._stages:
+            df = get_session().createDataFrame(cur)
+            for s in self._stages:
+                df = s.transform(df)
+            cur = df.toPandas()
+        return extract_features(cur, self.featuresCol)
+
+    def score_batches(self, batches: Iterable) -> Iterator[np.ndarray]:
+        """Pipeline an iterator of pandas batches through the device: the
+        next batch is prepped and DISPATCHED before the previous result is
+        pulled back to host, so host staging overlaps device compute."""
+        pending = None
+        for b in batches:
+            launched = self._dispatch(self._prep(b))
+            if pending is not None:
+                out, n, fin = pending
+                yield fin(np.asarray(out, dtype=np.float64)[:n])
+            pending = launched
+        if pending is not None:
+            out, n, fin = pending
+            yield fin(np.asarray(out, dtype=np.float64)[:n])

@@ -195,6 +195,10 @@ def _affine(X, w, b):
 def predict_linear(X: np.ndarray, coefficients: np.ndarray, intercept: float) -> np.ndarray:
     if X.size == 0:
         return np.zeros((X.shape[0],))
+    if X.shape[0] >= 4096:
+        # large batches shard rows over the mesh (ML 12 throughput path)
+        from .inference import predict_linear_sharded
+        return predict_linear_sharded(X, coefficients, intercept)
     out = _affine(jnp.asarray(X, dtype=jnp.float32),
                   jnp.asarray(coefficients, dtype=jnp.float32),
                   jnp.float32(intercept))

@@ -350,7 +350,17 @@ class PyFuncModel:
         if self._kind == "sklearn":
             pred = self._native.predict(data)
             return np.asarray(pred)
-        # pipeline model: run transform over a temp frame
+        # native model: prefer the mesh-sharded device scorer (feature
+        # stages on host, model math sharded over chips — SURVEY P8);
+        # models without a device path fall back to frame transform
+        if not hasattr(self, "_scorer"):
+            from ..ml.inference import DeviceScorer
+            try:
+                self._scorer = DeviceScorer(self._native)
+            except TypeError:
+                self._scorer = None
+        if self._scorer is not None:
+            return self._scorer(pd.DataFrame(data))
         from ..frame.session import get_session
         df = get_session().createDataFrame(pd.DataFrame(data))
         out = self._native.transform(df).toPandas()

@@ -1,0 +1,110 @@
+"""Mesh-sharded batch inference (SURVEY §2.2 P8, `ML 12`).
+
+r1 had no device path for the pandas-UDF surface — model-backed UDF bodies
+looped on host. DeviceScorer + the sharded predict programs are that path.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu.ml import DeviceScorer, Pipeline
+from sml_tpu.ml.feature import VectorAssembler
+from sml_tpu.ml.regression import (LinearRegression, RandomForestRegressor)
+from sml_tpu.ml.classification import LogisticRegression
+
+
+@pytest.fixture()
+def fitted_lr(spark, airbnb_pdf):
+    df = spark.createDataFrame(airbnb_pdf)
+    va = VectorAssembler(inputCols=["bedrooms", "accommodates", "bathrooms"],
+                         outputCol="features")
+    lr = LinearRegression(featuresCol="features", labelCol="price")
+    pipe = Pipeline(stages=[va, lr]).fit(df)
+    return pipe, df
+
+
+def test_device_scorer_matches_transform_linear(fitted_lr):
+    pipe, df = fitted_lr
+    expected = pipe.transform(df).toPandas()["prediction"].to_numpy()
+    scorer = DeviceScorer(pipe)
+    got = scorer(df.toPandas())
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_device_scorer_raw_block(fitted_lr):
+    pipe, df = fitted_lr
+    lr_model = pipe.stages[-1]
+    scorer = DeviceScorer(lr_model)
+    X = np.random.default_rng(0).normal(size=(100, 3)).astype(np.float32)
+    w = lr_model.coefficients.toArray()
+    b = lr_model.intercept
+    np.testing.assert_allclose(scorer.score_block(X), X @ w + b, rtol=1e-4)
+
+
+def test_device_scorer_forest(spark, airbnb_pdf):
+    df = spark.createDataFrame(airbnb_pdf)
+    va = VectorAssembler(inputCols=["bedrooms", "accommodates", "bathrooms"],
+                         outputCol="features")
+    rf = RandomForestRegressor(featuresCol="features", labelCol="price",
+                               numTrees=5, maxDepth=4, seed=42)
+    pipe = Pipeline(stages=[va, rf]).fit(df)
+    expected = pipe.transform(df).toPandas()["prediction"].to_numpy()
+    got = DeviceScorer(pipe)(df.toPandas())
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_device_scorer_logistic(spark, airbnb_pdf):
+    pdf = airbnb_pdf.copy()
+    pdf["expensive"] = (pdf["price"] > pdf["price"].median()).astype(float)
+    df = spark.createDataFrame(pdf)
+    va = VectorAssembler(inputCols=["bedrooms", "accommodates"],
+                         outputCol="features")
+    logr = LogisticRegression(featuresCol="features", labelCol="expensive")
+    pipe = Pipeline(stages=[va, logr]).fit(df)
+    probs = pipe.transform(df).toPandas()["probability"]
+    expected = probs.array.block[:, 1]
+    got = DeviceScorer(pipe)(df.toPandas())
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-6)
+
+
+def test_score_batches_pipelined(fitted_lr):
+    pipe, df = fitted_lr
+    scorer = DeviceScorer(pipe)
+    pdf = df.toPandas()
+    batches = [pdf.iloc[i:i + 500] for i in range(0, len(pdf), 500)]
+    outs = list(scorer.score_batches(batches))
+    assert len(outs) == len(batches)
+    whole = scorer(pdf)
+    np.testing.assert_allclose(np.concatenate(outs), whole, rtol=1e-5)
+
+
+def test_sharded_predict_large_batch_matches_small(fitted_lr):
+    """The >=4096-row sharded path and the single-device path must agree."""
+    pipe, _ = fitted_lr
+    lr_model = pipe.stages[-1]
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(5000, 3)).astype(np.float32)
+    from sml_tpu.ml.linear_impl import predict_linear
+    big = predict_linear(X, lr_model.coefficients.toArray(), lr_model.intercept)
+    small = np.concatenate([
+        predict_linear(X[i:i + 1000], lr_model.coefficients.toArray(),
+                       lr_model.intercept) for i in range(0, 5000, 1000)])
+    np.testing.assert_allclose(big, small, rtol=1e-5)
+
+
+def test_pyfunc_predict_uses_device_path(spark, airbnb_pdf, tmp_path):
+    import sml_tpu.tracking as mlflow
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    df = spark.createDataFrame(airbnb_pdf)
+    va = VectorAssembler(inputCols=["bedrooms", "accommodates"],
+                         outputCol="features")
+    lr = LinearRegression(featuresCol="features", labelCol="price")
+    pipe = Pipeline(stages=[va, lr]).fit(df)
+    with mlflow.start_run() as run:
+        mlflow.spark.log_model(pipe, "model")
+    loaded = mlflow.pyfunc.load_model(f"runs:/{run.info.run_id}/model")
+    preds = loaded.predict(airbnb_pdf)
+    expected = pipe.transform(df).toPandas()["prediction"].to_numpy()
+    np.testing.assert_allclose(np.asarray(preds), expected, rtol=1e-5)
+    assert loaded._scorer is not None  # device path engaged, not fallback
