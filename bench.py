@@ -1,77 +1,95 @@
 #!/usr/bin/env python
 """End-to-end benchmark: the course's ML 02–ML 13 compute path on TPU.
 
-Runs the BASELINE.json config suite against a deterministic SF-Airbnb-shaped
+Covers every BASELINE.json config against a deterministic SF-Airbnb-shaped
 dataset (the real one is blob-hosted; same schema/size class, seed 42):
 
   ML 02/03  StringIndexer+OHE+VectorAssembler+LinearRegression fit+predict
-  ML 06/07  DecisionTree + RandomForest fit+predict
-  ML 11     XGBoost-equivalent (tpu_hist boosted trees) fit+predict
-  ML 12     mapInPandas batch inference
+  ML 06/07  DecisionTree + RandomForest, then the ML 07 CrossValidator grid
+            (maxDepth x numTrees, 3 folds, parallelism=4 — `ML 07:102-149`)
+  ML 08     Hyperopt-style TPE search over RF params (4 evals, the course
+            budget — `ML 08:146`)
+  ML 11     XGBoost-equivalent (tpu_hist boosted trees), log-price target
+  ML 12     batch inference via DeviceScorer-backed mapInPandas
   ML 13     applyInPandas per-group training
 
-Prints ONE JSON line: wall-clock of the whole suite (after a compile warmup
-pass on small data, so the number measures steady-state execution the way
-the reference cluster — with its JIT-warm JVM — was measured).
-`vs_baseline` is suite_rows/sec ÷ 2000 rows/s, a conservative anchor for the
-same workload class on the reference's 8×A10G Databricks cluster
-(BASELINE.json publishes no numbers; SURVEY §6)."""
+Output: ONE JSON line. `value` is the steady-state suite wall-clock
+(compile warmup reported separately in `compile_seconds` — compile
+economics are part of the story, not discarded). `vs_baseline` is the
+speedup over a MEASURED single-node pandas/sklearn execution of the same
+legs on the same host (cached in baseline_host.json; delete it to
+re-measure). The reference publishes no numbers (SURVEY §6), so the
+measured host baseline replaces r1's invented rows/sec anchor.
+"""
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 N_ROWS = 60_000
-BASELINE_ROWS_PER_SEC = 2000.0
+HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_CACHE = os.path.join(HERE, "baseline_host.json")
+
+# peak dense f32 throughput used for the MFU estimate when running on a
+# real TPU chip (v5e-class); on CPU the estimate is skipped
+TPU_PEAK_F32_FLOPS = 4.9e13
 
 
 def build_dataset(n):
     from sml_tpu.courseware import make_airbnb_dataset
     from sml_tpu.frame.session import get_session
     pdf = make_airbnb_dataset(n=n, seed=42)
-    return get_session().createDataFrame(pdf)
+    return get_session().createDataFrame(pdf), pdf
+
+
+CAT_COLS = ["neighbourhood_cleansed", "room_type", "property_type"]
+NUM_COLS = ["accommodates", "bathrooms", "bedrooms", "beds",
+            "minimum_nights", "number_of_reviews", "review_scores_rating"]
 
 
 def run_suite(df, n_rows):
-    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml import DeviceScorer, Pipeline
     from sml_tpu.ml.evaluation import RegressionEvaluator
     from sml_tpu.ml.feature import (Imputer, OneHotEncoder, StringIndexer,
                                     VectorAssembler)
     from sml_tpu.ml.regression import (DecisionTreeRegressor,
+                                       LinearRegression,
                                        RandomForestRegressor)
+    from sml_tpu.ml.tuning import CrossValidator, ParamGridBuilder
+    from sml_tpu.tune import Trials, fmin, hp, tpe
     from sml_tpu.xgboost import XgboostRegressor
 
     timings = {}
+    flops = {}
     train, test = df.randomSplit([0.8, 0.2], seed=42)
     train.cache()
     test.cache()
-    cat_cols = ["neighbourhood_cleansed", "room_type", "property_type"]
-    num_cols = ["accommodates", "bathrooms", "bedrooms", "beds",
-                "minimum_nights", "number_of_reviews", "review_scores_rating"]
-    idx = [c + "_idx" for c in cat_cols]
-    ohe = [c + "_ohe" for c in cat_cols]
-    imp = [c + "_imp" for c in num_cols]
+    n_train = train.count()
+    idx = [c + "_idx" for c in CAT_COLS]
+    ohe = [c + "_ohe" for c in CAT_COLS]
+    imp = [c + "_imp" for c in NUM_COLS]
     prep = [
-        Imputer(strategy="median", inputCols=num_cols, outputCols=imp),
-        StringIndexer(inputCols=cat_cols, outputCols=idx, handleInvalid="skip"),
+        Imputer(strategy="median", inputCols=NUM_COLS, outputCols=imp),
+        StringIndexer(inputCols=CAT_COLS, outputCols=idx, handleInvalid="skip"),
     ]
     ev = RegressionEvaluator(labelCol="price")
 
-    # ML 02/03: linear pipeline
+    # ---- ML 02/03: linear pipeline --------------------------------------
     t0 = time.perf_counter()
-    lr_pipe = Pipeline(stages=prep + [
+    lr_model = Pipeline(stages=prep + [
         OneHotEncoder(inputCols=idx, outputCols=ohe),
         VectorAssembler(inputCols=ohe + imp, outputCol="features"),
-    ])
-    from sml_tpu.ml.regression import LinearRegression
-    lr_model = Pipeline(stages=lr_pipe.getStages()
-                        + [LinearRegression(labelCol="price")]).fit(train)
+        LinearRegression(labelCol="price"),
+    ]).fit(train)
     rmse_lr = ev.evaluate(lr_model.transform(test))
     timings["ml02_lr"] = time.perf_counter() - t0
+    d_lr = lr_model.stages[-1].coefficients.toArray().shape[0] + 1
+    flops["ml02_lr"] = 2.0 * n_train * d_lr * d_lr  # Gram pass X^T X
 
-    # ML 06/07: trees (indexed categoricals, no OHE — ML 06:42)
+    # ---- ML 06/07: single trees then the CV grid ------------------------
     tree_feats = VectorAssembler(inputCols=idx + imp, outputCol="features")
     t0 = time.perf_counter()
     dt_model = Pipeline(stages=prep + [tree_feats,
@@ -88,7 +106,39 @@ def run_suite(df, n_rows):
     rmse_rf = ev.evaluate(rf_model.transform(test))
     timings["ml07_rf"] = time.perf_counter() - t0
 
-    # ML 11: boosted trees, log-price target (exp back-transform)
+    # the ML 07 tuning shape: grid over maxDepth x numTrees, 3 seeded folds,
+    # parallelism=4 (trials placed on disjoint submeshes)
+    t0 = time.perf_counter()
+    imputed = prep[0].fit(train).transform(train)
+    feat_train = tree_feats.transform(
+        prep[1].fit(imputed).transform(imputed))
+    rf = RandomForestRegressor(labelCol="price", maxBins=40, seed=42)
+    grid = (ParamGridBuilder()
+            .addGrid(rf.getParam("maxDepth"), [2, 5])
+            .addGrid(rf.getParam("numTrees"), [10, 20]).build())
+    cv = CrossValidator(estimator=rf, estimatorParamMaps=grid, evaluator=ev,
+                        numFolds=3, parallelism=4, seed=42)
+    cv_model = cv.fit(feat_train)
+    timings["ml07_cv"] = time.perf_counter() - t0
+    cv_best = float(min(cv_model.avgMetrics))
+
+    # ---- ML 08: TPE search, course budget of 4 evals --------------------
+    t0 = time.perf_counter()
+    space = {"max_depth": hp.quniform("max_depth", 2, 8, 1),
+             "num_trees": hp.quniform("num_trees", 5, 25, 5)}
+
+    def objective(params):
+        m = RandomForestRegressor(labelCol="price", maxBins=40, seed=42,
+                                  maxDepth=int(params["max_depth"]),
+                                  numTrees=int(params["num_trees"])) \
+            .fit(feat_train)
+        return ev.evaluate(m.transform(feat_train))
+
+    fmin(objective, space, algo=tpe, max_evals=4, trials=Trials(),
+         rstate=np.random.RandomState(42))
+    timings["ml08_hyperopt"] = time.perf_counter() - t0
+
+    # ---- ML 11: boosted trees, log-price --------------------------------
     from sml_tpu.frame import functions as F
     t0 = time.perf_counter()
     log_train = train.withColumn("label", F.log(F.col("price")))
@@ -101,27 +151,24 @@ def run_suite(df, n_rows):
         "prediction", F.exp(F.col("prediction")))
     rmse_xgb = ev.evaluate(pred)
     timings["ml11_xgb"] = time.perf_counter() - t0
+    # histogram builds: levels x rows x features scatter-adds (ops, not
+    # dense MXU flops — reported for scale, excluded from MFU)
+    flops["ml11_xgb"] = 40.0 * 6 * n_train * len(idx + imp) * 4
 
-    # ML 12: mapInPandas batch inference with the fitted LR model
+    # ---- ML 12: batch inference through the device scorer ---------------
     t0 = time.perf_counter()
-    lr_last = lr_model.stages[-1]
-    scored_input = test
-    for s in lr_model.stages[:-1]:
-        scored_input = s.transform(scored_input)
-    w = lr_last.coefficients.toArray()
-    b = lr_last.intercept
+    scorer = DeviceScorer(lr_model)
 
     def predict_batches(it):
         import pandas as pd
-        for pdf in it:
-            X = np.stack([v.toArray() for v in pdf["features"]])
-            yield pd.DataFrame({"prediction": X @ w + b})
+        for out in scorer.score_batches(it):
+            yield pd.DataFrame({"prediction": out})
 
-    n_scored = scored_input.mapInPandas(predict_batches,
-                                        "prediction double").count()
+    n_scored = test.mapInPandas(predict_batches, "prediction double").count()
     timings["ml12_mapinpandas"] = time.perf_counter() - t0
+    flops["ml12_mapinpandas"] = 2.0 * n_scored * d_lr
 
-    # ML 13: per-group training fan-out
+    # ---- ML 13: per-group training fan-out ------------------------------
     t0 = time.perf_counter()
 
     def train_group(pdf):
@@ -142,35 +189,152 @@ def run_suite(df, n_rows):
     timings["ml13_applyinpandas"] = time.perf_counter() - t0
 
     metrics = {"rmse_lr": rmse_lr, "rmse_dt": rmse_dt, "rmse_rf": rmse_rf,
-               "rmse_xgb": rmse_xgb, "rows_scored": n_scored,
-               "groups": n_groups}
-    return timings, metrics
+               "rmse_xgb": rmse_xgb, "cv_best_rmse": cv_best,
+               "rows_scored": n_scored, "groups": n_groups}
+    return timings, metrics, flops
+
+
+# ---------------------------------------------------------------- host baseline
+def run_host_baseline(pdf):
+    """The SAME legs executed the single-node pandas/sklearn way — the
+    measured anchor for vs_baseline (replaces r1's invented constant)."""
+    import pandas as pd
+    from sklearn.ensemble import (HistGradientBoostingRegressor,
+                                  RandomForestRegressor as SkRF)
+    from sklearn.linear_model import LinearRegression as SkLR
+    from sklearn.model_selection import GridSearchCV, train_test_split
+    from sklearn.tree import DecisionTreeRegressor as SkDT
+
+    timings = {}
+    work = pdf.copy()
+    for c in NUM_COLS:
+        work[c] = pd.to_numeric(work[c], errors="coerce")
+        work[c] = work[c].fillna(work[c].median())
+    train, test = train_test_split(work, test_size=0.2, random_state=42)
+
+    def featurize(frame, ohe):
+        X = pd.get_dummies(frame[CAT_COLS], dtype=float) if ohe else \
+            frame[CAT_COLS].apply(lambda s: s.astype("category").cat.codes)
+        return pd.concat([X, frame[NUM_COLS]], axis=1).to_numpy(np.float64)
+
+    t0 = time.perf_counter()
+    Xtr, Xte = featurize(train, True), featurize(test, True)
+    m = SkLR().fit(Xtr, train["price"])
+    float(np.sqrt(np.mean((m.predict(Xte) - test["price"]) ** 2)))
+    timings["ml02_lr"] = time.perf_counter() - t0
+
+    Xtr_t, Xte_t = featurize(train, False), featurize(test, False)
+    t0 = time.perf_counter()
+    SkDT(max_depth=5).fit(Xtr_t, train["price"]).predict(Xte_t)
+    timings["ml06_dt"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    SkRF(max_depth=6, n_estimators=20, random_state=42, n_jobs=-1) \
+        .fit(Xtr_t, train["price"]).predict(Xte_t)
+    timings["ml07_rf"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    gs = GridSearchCV(SkRF(random_state=42, n_jobs=-1),
+                      {"max_depth": [2, 5], "n_estimators": [10, 20]},
+                      cv=3, scoring="neg_root_mean_squared_error", n_jobs=1)
+    gs.fit(Xtr_t, train["price"])
+    timings["ml07_cv"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rng = np.random.RandomState(42)
+    for _ in range(4):  # 4-eval random/TPE-budget search (ML 08:146)
+        SkRF(max_depth=int(rng.randint(2, 9)),
+             n_estimators=int(rng.choice([5, 10, 15, 20, 25])),
+             random_state=42, n_jobs=-1).fit(Xtr_t, train["price"]) \
+            .predict(Xtr_t)
+    timings["ml08_hyperopt"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    HistGradientBoostingRegressor(max_iter=40, learning_rate=0.15,
+                                  max_depth=6, max_bins=64, random_state=42) \
+        .fit(Xtr_t, np.log(train["price"])).predict(Xte_t)
+    timings["ml11_xgb"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    bs = 4096
+    preds = [m.predict(Xte[lo:lo + bs]) for lo in range(0, len(Xte), bs)]
+    np.concatenate(preds)
+    timings["ml12_mapinpandas"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _, g in train.groupby("room_type"):
+        g = g.dropna(subset=["accommodates", "bedrooms", "price"])
+        if len(g) >= 5:
+            gm = SkLR().fit(g[["accommodates", "bedrooms"]], g["price"])
+            float(np.mean((gm.predict(g[["accommodates", "bedrooms"]])
+                           - g["price"]) ** 2))
+    timings["ml13_applyinpandas"] = time.perf_counter() - t0
+    return timings
+
+
+def get_host_baseline(pdf):
+    if os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            cached = json.load(f)
+        if cached.get("n_rows") == N_ROWS:
+            return cached["timings"]
+    print("measuring single-node host baseline (cached afterwards)...",
+          file=sys.stderr)
+    timings = run_host_baseline(pdf)
+    with open(BASELINE_CACHE, "w") as f:
+        json.dump({"n_rows": N_ROWS, "timings": timings,
+                   "note": "single-node pandas/sklearn execution of the same "
+                           "legs on the same host; measured, not assumed"},
+                  f, indent=1)
+    return timings
 
 
 def main():
     import jax
+    backend = jax.default_backend()
     print(f"devices: {jax.devices()}", file=sys.stderr)
-    df = build_dataset(N_ROWS)
+    df, pdf = build_dataset(N_ROWS)
     df.cache()
-    # warmup pass at FULL shapes so the timed pass measures steady-state
-    # execution, not XLA compiles (shapes are part of the compile key)
+    base = get_host_baseline(pdf)
+
+    # warmup pass at FULL shapes: measures compile+first-exec economics
+    # (SURVEY §7 hard-part #6) — reported, not discarded
     t0 = time.perf_counter()
     run_suite(df, N_ROWS)
-    print(f"warmup (incl. compiles): {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr)
+    compile_secs = time.perf_counter() - t0
     t0 = time.perf_counter()
-    timings, metrics = run_suite(df, N_ROWS)
+    timings, metrics, flops = run_suite(df, N_ROWS)
     wall = time.perf_counter() - t0
+    base_wall = sum(base.get(k, 0.0) for k in timings)
+
+    per_leg = {}
     for k, v in sorted(timings.items()):
-        print(f"  {k:22s} {v:7.2f}s", file=sys.stderr)
+        leg = {"seconds": round(v, 3),
+               "rows_per_sec": round(N_ROWS / v, 1),
+               "host_baseline_seconds": round(base.get(k, float("nan")), 3),
+               "speedup_vs_host": round(base[k] / v, 2) if k in base else None}
+        if k in flops:
+            leg["device_flops_est"] = flops[k]
+            if backend == "tpu" and k != "ml11_xgb":
+                leg["mfu_pct"] = round(
+                    100.0 * flops[k] / v / TPU_PEAK_F32_FLOPS, 4)
+        per_leg[k] = leg
+        print(f"  {k:22s} {v:7.2f}s  (host {base.get(k, float('nan')):7.2f}s)",
+              file=sys.stderr)
     for k, v in sorted(metrics.items()):
         print(f"  {k:22s} {v:10.3f}", file=sys.stderr)
-    rows_per_sec = N_ROWS / wall
+    print(f"  compile+first-exec pass: {compile_secs:.1f}s", file=sys.stderr)
+
     print(json.dumps({
-        "metric": "ml02-ml13 suite wall-clock (60k-row SF-Airbnb-class, fit+predict)",
+        "metric": "ml02-ml13 suite wall-clock (60k-row SF-Airbnb-class, "
+                  "all 5 BASELINE configs, fit+predict)",
         "value": round(wall, 3),
         "unit": "seconds",
-        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+        "vs_baseline": round(base_wall / wall, 3),
+        "baseline_seconds_measured_host": round(base_wall, 3),
+        "compile_seconds": round(compile_secs, 1),
+        "backend": backend,
+        "legs": per_leg,
     }))
 
 

@@ -140,6 +140,36 @@ class DataFrame:
         out._ml_attrs = dict(self._ml_attrs)
         return out
 
+    def _derive_rowlocal(self, fn: Callable[[pd.DataFrame, EvalContext], pd.DataFrame],
+                         schema: Optional[StructType] = None) -> "DataFrame":
+        """_derive for ROW-LOCAL, row-count-preserving fns (model predicts):
+        applies fn ONCE over the concatenated partitions and splits the
+        result back on the same boundaries. One device round trip instead of
+        one per partition — on the TPU tunnel each round trip has a fixed
+        D2H latency, so per-partition prediction was paying it 8x."""
+        parent = self
+
+        def compute() -> Partitions:
+            parts = parent._materialize()
+            if len(parts) <= 1:
+                ctxs = parent._contexts()
+                return [fn(p, c) for p, c in zip(parts, ctxs)]
+            whole = pd.concat(parts, ignore_index=True)
+            out = fn(whole, EvalContext(0, 1, 0))
+            if len(out) != len(whole):
+                raise ValueError("_derive_rowlocal fn must preserve row count")
+            bounds = np.cumsum([len(p) for p in parts])[:-1]
+            lo = 0
+            split = []
+            for hi in list(bounds) + [len(out)]:
+                split.append(out.iloc[lo:hi].reset_index(drop=True))
+                lo = hi
+            return split
+
+        out = DataFrame(compute, session=self._session, schema=schema)
+        out._ml_attrs = dict(self._ml_attrs)
+        return out
+
     # ------------------------------------------------------------ metadata
     @property
     def schema(self) -> StructType:
@@ -605,20 +635,36 @@ class DataFrame:
 
     # ------------------------------------------------------------- pandas fn
     def mapInPandas(self, fn: Callable, schema: Union[str, StructType]) -> "DataFrame":
-        """Per-partition iterator-of-batches map (`ML 12:125-143`); batch size
-        follows `sml.arrow.maxRecordsPerBatch`."""
+        """Iterator-of-batches map (`ML 12:125-143`); batch size follows
+        `sml.arrow.maxRecordsPerBatch`.
+
+        The UDF is invoked ONCE with an iterator streaming every partition's
+        batches (Spark's contract is per-executor-task; any batch boundary
+        is valid). One invocation lets expensive UDF state — a loaded model,
+        a compiled device program — amortize across the whole dataset, and
+        lets device-backed UDF bodies (`DeviceScorer.score_batches`)
+        pipeline host staging under device compute across batches.
+        """
         sch = parse_schema(schema)
         parent = self
 
-        def part_fn(pdf: pd.DataFrame, ctx: EvalContext) -> pd.DataFrame:
+        def compute():
+            parts = parent._materialize()
             bs = GLOBAL_CONF.getInt("sml.arrow.maxRecordsPerBatch")
-            batches = [pdf.iloc[i:i + bs].reset_index(drop=True) for i in range(0, max(len(pdf), 1), bs)] \
-                if len(pdf) else [pdf]
-            outs = [b for b in fn(iter(batches))]
-            res = pd.concat(outs, ignore_index=True) if outs else pd.DataFrame()
-            return coerce_to_schema(res, sch)
 
-        return parent._derive(part_fn, schema=sch)
+            def batches():
+                for pdf in parts:
+                    if len(pdf) == 0:
+                        continue
+                    for i in range(0, len(pdf), bs):
+                        yield pdf.iloc[i:i + bs].reset_index(drop=True)
+
+            outs = [coerce_to_schema(b, sch) for b in fn(batches())]
+            return outs if outs else [coerce_to_schema(pd.DataFrame(), sch)]
+
+        out = DataFrame(compute, session=self._session, schema=sch)
+        out._ml_attrs = dict(self._ml_attrs)
+        return out
 
     # ------------------------------------------------------------- views / IO
     def createOrReplaceTempView(self, name: str) -> None:

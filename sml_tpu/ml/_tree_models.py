@@ -239,7 +239,7 @@ class _TreeRegressionModel(_TreeModelBase):
             out[oc] = self._margin(out)
             return out
 
-        return df._derive(fn)
+        return df._derive_rowlocal(fn)
 
 
 class _TreeClassificationModel(_TreeModelBase):
@@ -266,7 +266,7 @@ class _TreeClassificationModel(_TreeModelBase):
             out[oc] = (p1 > 0.5).astype(float)
             return out
 
-        return df._derive(fn)
+        return df._derive_rowlocal(fn)
 
 
 # ------------------------------------------------------------ estimators

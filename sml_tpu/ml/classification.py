@@ -147,7 +147,7 @@ class LogisticRegressionModel(Model):
             out[pc] = (p1 > thr).astype(float)
             return out
 
-        return df._derive(fn)
+        return df._derive_rowlocal(fn)
 
     def _save_state(self, path):
         save_arrays(path, coefficients=self._coefficients,

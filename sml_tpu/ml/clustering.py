@@ -147,7 +147,7 @@ class KMeansModel(Model):
             out[oc] = np.argmin(d2, axis=1).astype(np.int32)
             return out
 
-        return df._derive(fn)
+        return df._derive_rowlocal(fn)
 
     def _save_state(self, path):
         save_arrays(path, centers=self._centers,

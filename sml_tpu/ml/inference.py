@@ -66,12 +66,10 @@ def _forest_program(depth: int):
 
 
 def _stage_rows(X: np.ndarray):
-    mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
-    padded, n_true = meshlib.pad_rows(np.asarray(X), n_dev)
-    dev = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
-    mask = meshlib.row_mask(padded.shape[0], n_true)
-    mask_dev = jax.device_put(mask, meshlib.data_sharding(mesh, 1))
+    from ._staging import stage_mask_cached, stage_rows_cached
+    n_true = np.asarray(X).shape[0]
+    dev = stage_rows_cached(X)
+    mask_dev = stage_mask_cached(dev.shape[0], n_true)
     return dev, mask_dev, n_true
 
 
@@ -198,17 +196,27 @@ class DeviceScorer:
             cur = df.toPandas()
         return extract_features(cur, self.featuresCol)
 
-    def score_batches(self, batches: Iterable) -> Iterator[np.ndarray]:
-        """Pipeline an iterator of pandas batches through the device: the
-        next batch is prepped and DISPATCHED before the previous result is
-        pulled back to host, so host staging overlaps device compute."""
-        pending = None
+    def score_batches(self, batches: Iterable,
+                      depth: int = 4) -> Iterator[np.ndarray]:
+        """Pipeline an iterator of pandas batches through the device: up to
+        `depth` batches are dispatched ahead with async host copies started
+        at dispatch, so H2D staging, device compute, and D2H transfers all
+        overlap — the device→host latency is paid ~once, not per batch."""
+        from collections import deque
+        pending: deque = deque()
+
+        def drain_one():
+            out, n, fin = pending.popleft()
+            return fin(np.asarray(out, dtype=np.float64)[:n])
+
         for b in batches:
-            launched = self._dispatch(self._prep(b))
-            if pending is not None:
-                out, n, fin = pending
-                yield fin(np.asarray(out, dtype=np.float64)[:n])
-            pending = launched
-        if pending is not None:
-            out, n, fin = pending
-            yield fin(np.asarray(out, dtype=np.float64)[:n])
+            out, n, fin = self._dispatch(self._prep(b))
+            try:
+                out.copy_to_host_async()
+            except Exception:
+                pass
+            pending.append((out, n, fin))
+            if len(pending) >= depth:
+                yield drain_one()
+        while pending:
+            yield drain_one()

@@ -31,6 +31,9 @@ class LinearFit(NamedTuple):
     coefficients: np.ndarray
     intercept: float
     iterations: int
+    # training-fit statistics derived from the SAME Gram pass (no second
+    # data pass): {"sse", "var_y", "var_pred", "n"} — see fit_linear
+    stats: Optional[dict] = None
 
 
 def _gram_pass(Xb, yb, mask):
@@ -41,14 +44,31 @@ def _gram_pass(Xb, yb, mask):
     A = coll.psum(Xa.T @ Xa)            # MXU matmul then ICI allreduce
     b = coll.psum(Xa.T @ yb)
     n = coll.psum(jnp.sum(mask))
-    return A, b, n
+    yy = coll.psum(jnp.sum(yb * yb))
+    return A, b, n, yy
 
 
-def gram_stats(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray, float]:
-    """One data-parallel pass: (A = [X 1]^T [X 1], b = [X 1]^T y, n)."""
-    A, b, n = run_data_parallel(_gram_pass, X.astype(np.float32),
-                                y.astype(np.float32))
-    return np.asarray(A, dtype=np.float64), np.asarray(b, dtype=np.float64), float(n)
+def gram_stats(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """One data-parallel pass: (A = [X 1]^T [X 1], b = [X 1]^T y, n, y^T y).
+    ONE device round trip — every downstream fit statistic is a host-side
+    identity on these moments."""
+    A, b, n, yy = run_data_parallel(_gram_pass, X.astype(np.float32),
+                                    y.astype(np.float32))
+    return (np.asarray(A, dtype=np.float64), np.asarray(b, dtype=np.float64),
+            float(n), float(yy))
+
+
+def _fit_stats(A, b, n_f, yy, w_full):
+    """Training rmse/r2/explained-variance from Gram identities:
+    SSE = y'y - 2 w'b + w'Aw;  sum(pred) = A[-1, :] @ w  (last Gram row is
+    the column-sum of [X 1]);  var(pred) = w'Aw/n - mean(pred)^2."""
+    sse = float(yy - 2.0 * w_full @ b + w_full @ A @ w_full)
+    sy = b[-1] / n_f
+    var_y = float(yy / n_f - sy * sy)
+    mean_pred = float(A[-1, :] @ w_full) / n_f
+    var_pred = float(w_full @ A @ w_full) / n_f - mean_pred ** 2
+    return {"sse": max(sse, 0.0), "var_y": max(var_y, 0.0),
+            "var_pred": max(var_pred, 0.0), "n": n_f}
 
 
 def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
@@ -59,7 +79,7 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     sufficient statistics. Matches MLlib semantics: the penalty applies to
     standardized coefficients; the intercept is never penalized."""
     n, d = X.shape
-    A, b, n_f = gram_stats(X, y)
+    A, b, n_f, yy = gram_stats(X, y)
     # moments from the Gram pass (last row/col hold the sums)
     sx = A[-1, :d] / n_f
     sy = b[-1] / n_f
@@ -79,12 +99,13 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
             scale = (std ** 2) if standardization else np.ones(d)
             reg[:d, :d] = np.diag(lam * n_f * scale)
         if not fitIntercept:
-            A = A[:d, :d]
-            b = b[:d]
-            sol = np.linalg.solve(A + reg[:d, :d] + 1e-9 * np.eye(d), b)
-            return LinearFit(sol, 0.0, 1)
+            sol = np.linalg.solve(A[:d, :d] + reg[:d, :d] + 1e-9 * np.eye(d),
+                                  b[:d])
+            w_full = np.concatenate([sol, [0.0]])
+            return LinearFit(sol, 0.0, 1, _fit_stats(A, b, n_f, yy, w_full))
         sol = np.linalg.solve(A + reg + 1e-9 * np.eye(d + 1), b)
-        return LinearFit(sol[:d], float(sol[d]), 1)
+        return LinearFit(sol[:d], float(sol[d]), 1,
+                         _fit_stats(A, b, n_f, yy, sol))
 
     # elastic net via FISTA on the (tiny, replicated) Gram — centered space
     Axx = A[:d, :d] / n_f - np.outer(sx, sx)
@@ -118,7 +139,8 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     if standardization:
         w = w / std
     intercept = float(sy - sx @ w) if fitIntercept else 0.0
-    return LinearFit(w, intercept, maxIter)
+    w_full = np.concatenate([w, [intercept]])
+    return LinearFit(w, intercept, maxIter, _fit_stats(A, b, n_f, yy, w_full))
 
 
 def _newton_pass(Xb, yb, mask, wb):

@@ -131,8 +131,9 @@ class ALS(Estimator):
             if nonneg:
                 itf = jnp.maximum(itf, 0.0)
 
+        uf_h, itf_h = jax.device_get((uf, itf))  # one batched transfer
         m = ALSModel(user_ids=u_ids, item_ids=i_ids,
-                     user_factors=np.asarray(uf), item_factors=np.asarray(itf))
+                     user_factors=uf_h, item_factors=itf_h)
         m._inherit_params(self)
         return m
 

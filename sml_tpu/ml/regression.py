@@ -35,14 +35,22 @@ class _PredictorParams:
 
 class LinearRegressionSummary:
     def __init__(self, rmse: float, r2: float, mae: float, explainedVariance: float,
-                 numInstances: int, objectiveHistory=None):
+                 numInstances: int, objectiveHistory=None, mae_fn=None):
         self.rootMeanSquaredError = rmse
         self.r2 = r2
-        self.meanAbsoluteError = mae
+        self._mae = mae
+        self._mae_fn = mae_fn  # lazy: MAE needs a residual pass, rmse/r2 don't
         self.meanSquaredError = rmse ** 2
         self.explainedVariance = explainedVariance
         self.numInstances = numInstances
         self.objectiveHistory = objectiveHistory or []
+
+    @property
+    def meanAbsoluteError(self) -> float:
+        if self._mae is None and self._mae_fn is not None:
+            self._mae = self._mae_fn()
+            self._mae_fn = None
+        return self._mae
 
 
 class LinearRegression(Estimator, _PredictorParams):
@@ -90,14 +98,22 @@ class LinearRegression(Estimator, _PredictorParams):
         model = LinearRegressionModel(coefficients=res.coefficients,
                                       intercept=res.intercept)
         model._inherit_params(self)
-        pred = linear_impl.predict_linear(X, res.coefficients, res.intercept)
-        resid = y - pred
-        var_y = float(np.var(y))
-        mse = float(np.mean(resid ** 2))
+        # rmse/r2/explained-variance come FREE from the fit's own Gram pass
+        # (linear_impl._fit_stats) — no second data pass, no extra device
+        # round trip; MAE (not Gram-derivable) is computed only if read
+        st = res.stats or {}
+        n_f = st.get("n", len(y))
+        mse = st.get("sse", 0.0) / n_f if n_f else 0.0
+        var_y = st.get("var_y", 0.0)
+
+        def lazy_mae(X=X, y=y, w=res.coefficients, b=res.intercept):
+            pred = linear_impl.predict_linear(X, w, b)
+            return float(np.mean(np.abs(y - pred)))
+
         model._summary = LinearRegressionSummary(
             rmse=float(np.sqrt(mse)), r2=1 - mse / var_y if var_y else 0.0,
-            mae=float(np.mean(np.abs(resid))),
-            explainedVariance=float(np.var(pred)), numInstances=len(y))
+            mae=None, mae_fn=lazy_mae,
+            explainedVariance=st.get("var_pred", 0.0), numInstances=int(n_f))
         return model
 
 
@@ -154,7 +170,7 @@ class LinearRegressionModel(Model, _PredictorParams):
             out[oc] = linear_impl.predict_linear(X, w, b)
             return out
 
-        return df._derive(fn)
+        return df._derive_rowlocal(fn)
 
     def _save_state(self, path):
         save_arrays(path, coefficients=self._coefficients,

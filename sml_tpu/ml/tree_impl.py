@@ -335,14 +335,15 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                                              replicated_argnums=(3,))
     compiled = _ensemble_cache[key]
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
-    packs, base = compiled(binned_dev, y_dev, mask_dev, rng)
-    packs = np.asarray(packs)      # ONE transfer: (T, 5, n_nodes)
+    packs, base = jax.device_get(compiled(binned_dev, y_dev, mask_dev, rng))
+    # ^ one batched D2H transfer for (packs, base): the tunnel charges a
+    # fixed latency per transfer, so never fetch leaves separately
     trees = [FittedTree(split_feature=p[0].astype(np.int32),
                         split_bin=p[1].astype(np.int32),
                         leaf_value=p[2].astype(np.float32),
                         gain=p[3].astype(np.float32),
                         cover=p[4].astype(np.float32)) for p in packs]
-    return trees, float(np.asarray(base))
+    return trees, float(base)
 
 
 def _build_tree_program(spec: TreeSpec):
@@ -374,17 +375,16 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
     compiled = _tree_cache[key]
     if feat_key is None:
         feat_key = jax.random.key_data(jax.random.PRNGKey(rng))
-    sf, sb, lv, g, cov = compiled(binned_dev, grad_dev, hess_dev, weight_dev,
-                                  feat_key)
-    sf, sb, lv = np.asarray(sf).copy(), np.asarray(sb), np.asarray(lv).copy()
-    cov = np.asarray(cov)
+    out = compiled(binned_dev, grad_dev, hess_dev, weight_dev, feat_key)
+    sf, sb, lv, g, cov = jax.device_get(out)  # one batched transfer
+    sf, lv = sf.copy(), lv.copy()
     # nodes never reached in training (zero cover) inherit the parent value so
     # unseen routes at predict time fall back gracefully
     for i in range(1, len(lv)):
         if cov[i] == 0:
             lv[i] = lv[(i - 1) // 2]
             sf[i] = -1
-    return FittedTree(sf, sb, lv, np.asarray(g), cov)
+    return FittedTree(sf, sb, lv, g, cov)
 
 
 # ---------------------------------------------------------------------------
@@ -462,7 +462,7 @@ def stage_tree_data(X: np.ndarray, y: np.ndarray, max_bins: int,
 
 def stage_aligned(arr: np.ndarray, n_padded: int):
     """Shard a per-row array aligned with previously staged binned data."""
-    mesh = meshlib.get_mesh()
+    from ._staging import stage_rows_cached
     padded = np.zeros((n_padded,) + arr.shape[1:], dtype=np.float32)
     padded[:arr.shape[0]] = arr
-    return jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    return stage_rows_cached(padded, pad_to_multiple=False)
