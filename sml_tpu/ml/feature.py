@@ -192,19 +192,22 @@ class StringIndexerModel(Model):
             out = pdf.copy()
             keep_mask = np.ones(len(pdf), dtype=bool)
             for c, oc, mapping in zip(in_cols, out_cols, maps):
-                vals = out[c].map(lambda v: None if v is None or
-                                  (isinstance(v, float) and np.isnan(v)) else str(v))
-                idx = vals.map(lambda v: mapping.get(v) if v is not None else None)
-                missing = idx.isna().values
+                col = out[c]
+                notna = col.notna().to_numpy()
+                # vectorized dict lookup (C path), no per-row lambdas
+                idx = col.astype(str).map(mapping)
+                idx[~notna] = np.nan
+                missing = idx.isna().to_numpy()
                 if missing.any():
                     if invalid == "error":
-                        bad = vals[missing].iloc[0]
+                        bad = col[missing].iloc[0]
                         raise ValueError(f"Unseen label {bad!r} in column {c!r} "
                                          f"(handleInvalid='error')")
                     if invalid == "skip":
                         keep_mask &= ~missing
                     else:  # keep → extra index = numLabels
-                        idx = idx.where(~pd.Series(missing), float(len(mapping)))
+                        idx = idx.where(~pd.Series(missing, index=idx.index),
+                                        float(len(mapping)))
                 out[oc] = idx.astype(float)
             if not keep_mask.all():
                 out = out[keep_mask].reset_index(drop=True)
