@@ -93,11 +93,16 @@ class KMeans(Estimator):
             centers.append(sample[rng.choice(len(sample), p=p)])
         init = np.stack(centers).astype(np.float32)
 
-        Xd, mask, _ = stage_sharded(X.astype(np.float32))
-        from ._staging import cached_data_parallel
-        program = cached_data_parallel(_lloyd_program(k, max_iter),
-                                       replicated_argnums=(2,))
-        final_centers, cost = program(Xd, mask, init)
+        from ..parallel import dispatch
+        from ._staging import cached_data_parallel, routed_for
+        X32 = np.asarray(X, np.float32)
+        hint = dispatch.WorkHint(flops=3.0 * max_iter * X.size * k,
+                                 kind="blas")
+        with routed_for(hint, X32):
+            Xd, mask, _ = stage_sharded(X32)
+            program = cached_data_parallel(_lloyd_program(k, max_iter),
+                                           replicated_argnums=(2,))
+            final_centers, cost = program(Xd, mask, init)
         m = KMeansModel(centers=np.asarray(final_centers),
                         trainingCost=float(cost))
         m._inherit_params(self)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel import collectives as coll
+from ..parallel.dispatch import WorkHint
 from .base import Evaluator
 from ._staging import run_data_parallel
 
@@ -70,7 +71,8 @@ class RegressionEvaluator(Evaluator):
                                 self.getOrDefault("labelCol"))
         metric = self.getOrDefault("metricName")
         n, se, ae, sl, sl2 = run_data_parallel(
-            _reg_stats, pred.astype(np.float32), lab.astype(np.float32))
+            _reg_stats, pred.astype(np.float32), lab.astype(np.float32),
+            work=WorkHint(flops=10.0 * len(pred), kind="blas"))
         n = float(n)
         if n == 0:
             return float("nan")
@@ -171,8 +173,9 @@ class MulticlassClassificationEvaluator(Evaluator):
                                 self.getOrDefault("labelCol"))
         metric = self.getOrDefault("metricName")
         if metric == "accuracy":
-            c, n = run_data_parallel(_acc_stats, pred.astype(np.float32),
-                                     lab.astype(np.float32))
+            c, n = run_data_parallel(
+                _acc_stats, pred.astype(np.float32), lab.astype(np.float32),
+                work=WorkHint(flops=4.0 * len(pred), kind="blas"))
             return float(c) / float(n) if n else float("nan")
         classes = np.unique(np.concatenate([pred, lab]))
         stats = []

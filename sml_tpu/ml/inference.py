@@ -142,11 +142,25 @@ class DeviceScorer:
         return self._model.getOrDefault("featuresCol")
 
     def _dispatch(self, X: np.ndarray):
-        """Stage + launch the device program; returns (device_out, n_true,
-        finalize) without forcing the result — the pipelining hook."""
+        """Stage + launch the scoring program; returns (out, n_true,
+        finalize) without forcing the result — the pipelining hook. Each
+        batch is routed host/device by the measured-latency dispatcher
+        (VERDICT r2 #2: a fixed row cutover was wrong by orders of magnitude
+        on the tunneled chip); `out` is a host array on the host route."""
+        from ..parallel import dispatch as _dispatch_mod
+        from ._staging import route_for_arrays
         if self._kind == "linear":
             w, b, logistic = self._params
-            Xd, mask, n = _stage_rows(np.ascontiguousarray(X, np.float32))
+            n, d = np.shape(X)
+            X32 = np.ascontiguousarray(X, np.float32)
+            hint = _dispatch_mod.WorkHint(flops=2.0 * n * d, kind="blas",
+                                          out_bytes=4.0 * n)
+            if route_for_arrays(hint, X32)[1] == "host":
+                out = np.asarray(X, np.float64) @ np.asarray(w, np.float64) + b
+                if logistic:
+                    out = 1.0 / (1.0 + np.exp(-out))
+                return out, n, lambda m: m
+            Xd, mask, n = _stage_rows(X32)
             fwd = _logistic_forward if logistic else _linear_forward
             prog = cached_data_parallel(fwd, out_replicated=False,
                                         replicated_argnums=(2, 3))
@@ -155,13 +169,6 @@ class DeviceScorer:
             return out, n, lambda m: m
 
         spec, sf, sb, lv, w = self._params
-        from .tree_impl import bin_with
-        binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
-        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, np.int32))
-        prog = _forest_program(spec.depth)
-        out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
-                   jnp.asarray(lv, dtype=jnp.float32),
-                   jnp.asarray(w, dtype=jnp.float32))
 
         def finalize(margin):
             margin = spec.base + margin
@@ -172,6 +179,24 @@ class DeviceScorer:
                 return np.clip(margin, 0.0, 1.0)
             return margin
 
+        from .tree_impl import bin_with, predict_forest
+        binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
+        n = binned.shape[0]
+        hint = _dispatch_mod.WorkHint(
+            flops=4.0 * n * len(spec.trees) * spec.depth, kind="scatter",
+            out_bytes=4.0 * n)
+        mesh, route = route_for_arrays(hint, binned)
+        if route == "host":
+            import jax as _jax
+            with _jax.default_device(list(mesh.devices.flat)[0]):
+                margin = predict_forest(binned, spec.trees, spec.depth,
+                                        spec.tree_weights)
+            return margin, n, finalize
+        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, np.int32))
+        prog = _forest_program(spec.depth)
+        out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
+                   jnp.asarray(lv, dtype=jnp.float32),
+                   jnp.asarray(w, dtype=jnp.float32))
         return out, n, finalize
 
     def score_block(self, X: np.ndarray) -> np.ndarray:

@@ -106,32 +106,40 @@ class ALS(Estimator):
         i_ids, i_index = np.unique(items_raw, return_inverse=True)
         U, I = len(u_ids), len(i_ids)
 
-        # stage rating triples sharded by row
-        from ._staging import stage_sharded
-        u_dev, i_dev, r_dev, mask, _ = stage_sharded(
-            u_index.astype(np.int32), i_index.astype(np.int32), ratings)
+        # stage rating triples sharded by row; normal-equation accumulation
+        # is nnz·rank² per half-step plus (U+I)·rank³ Cholesky solves
+        from ..parallel import dispatch
+        from ._staging import routed_for, stage_sharded
+        u32 = u_index.astype(np.int32)
+        i32 = i_index.astype(np.int32)
+        _hint = dispatch.WorkHint(
+            flops=2.0 * max_iter * (len(ratings) * rank * rank
+                                    + (U + I) * rank ** 3),
+            kind="blas")
+        with routed_for(_hint, u32, i32, ratings):
+            u_dev, i_dev, r_dev, mask, _ = stage_sharded(u32, i32, ratings)
 
-        uf = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
-        itf = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
+            uf = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
+            itf = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
 
-        from ._staging import cached_data_parallel
-        solve_users = cached_data_parallel(_half_step_program(U, rank, reg))
-        solve_items = cached_data_parallel(_half_step_program(I, rank, reg))
+            from ._staging import cached_data_parallel
+            solve_users = cached_data_parallel(_half_step_program(U, rank, reg))
+            solve_items = cached_data_parallel(_half_step_program(I, rank, reg))
 
-        @jax.jit
-        def gather(factors, idx):
-            return factors[idx]
+            @jax.jit
+            def gather(factors, idx):
+                return factors[idx]
 
-        nonneg = bool(self.getOrDefault("nonnegative"))
-        for _ in range(max_iter):
-            uf = solve_users(u_dev, r_dev, mask, gather(itf, i_dev))
-            if nonneg:
-                uf = jnp.maximum(uf, 0.0)
-            itf = solve_items(i_dev, r_dev, mask, gather(uf, u_dev))
-            if nonneg:
-                itf = jnp.maximum(itf, 0.0)
+            nonneg = bool(self.getOrDefault("nonnegative"))
+            for _ in range(max_iter):
+                uf = solve_users(u_dev, r_dev, mask, gather(itf, i_dev))
+                if nonneg:
+                    uf = jnp.maximum(uf, 0.0)
+                itf = solve_items(i_dev, r_dev, mask, gather(uf, u_dev))
+                if nonneg:
+                    itf = jnp.maximum(itf, 0.0)
 
-        uf_h, itf_h = jax.device_get((uf, itf))  # one batched transfer
+            uf_h, itf_h = jax.device_get((uf, itf))  # one batched transfer
         m = ALSModel(user_ids=u_ids, item_ids=i_ids,
                      user_factors=uf_h, item_factors=itf_h)
         m._inherit_params(self)

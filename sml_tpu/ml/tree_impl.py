@@ -452,8 +452,15 @@ class StagedData(NamedTuple):
 
 
 def stage_tree_data(X: np.ndarray, y: np.ndarray, max_bins: int,
-                    categorical: Optional[Dict[int, int]] = None) -> StagedData:
-    binned, binning = make_bins(X, y, max_bins, categorical)
+                    categorical: Optional[Dict[int, int]] = None,
+                    prebinned=None) -> StagedData:
+    """`prebinned=(binned, binning)` skips re-binning when the caller
+    already discretized (it bins BEFORE routing so the dispatcher can probe
+    the staging cache with the actual device operand)."""
+    if prebinned is not None:
+        binned, binning = prebinned
+    else:
+        binned, binning = make_bins(X, y, max_bins, categorical)
     binned_dev, mask_dev, n_true = stage_sharded(binned)
     return StagedData(binned=binned, binned_dev=binned_dev, mask_dev=mask_dev,
                       y=y, n_true=n_true, binning=binning,

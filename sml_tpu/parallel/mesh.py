@@ -149,6 +149,21 @@ def pad_rows(x: np.ndarray, multiple: int, fill: float = 0.0) -> Tuple[np.ndarra
     return np.pad(x, pad_width, constant_values=fill), n
 
 
+def bucket_rows(n: int, multiple: int) -> int:
+    """Round a row count up to a coarse power-of-two-fraction grid (≤12.5%
+    padding) that also divides evenly by `multiple` (the mesh's data-axis
+    size). Near-size datasets — CV folds, tuning-trial re-fits, randomSplit
+    variations — land on the SAME padded shape and therefore the same
+    compiled program, instead of paying one XLA compile per exact row count
+    (SURVEY §7 hard-part #6; the padding tail is masked by every program)."""
+    n = max(int(n), 1)
+    multiple = max(int(multiple), 1)
+    target = max(n, multiple)
+    step = 1 << max(0, target.bit_length() - 4)  # grid of 8..16 * 2^k
+    b = ((target + step - 1) // step) * step
+    return ((b + multiple - 1) // multiple) * multiple
+
+
 def shard_rows(x: np.ndarray, mesh: Optional[Mesh] = None) -> Tuple[jax.Array, int]:
     """Stage a host array into HBM sharded by rows over DATA_AXIS.
 

@@ -1,0 +1,97 @@
+"""Latency-calibrated dispatch policy (parallel/dispatch.py).
+
+The policy itself is pure arithmetic over measured constants, so it is
+tested here with a pinned fake calibration (the real one needs a tunneled
+chip): small work routes host, large work routes device, and work that
+loses only by its one-time H2D cost triggers background promotion so later
+fits ride the chip (VERDICT r2 #1a/#2).
+"""
+
+import numpy as np
+import pytest
+
+from sml_tpu.conf import GLOBAL_CONF
+from sml_tpu.ml import _staging
+from sml_tpu.parallel import dispatch, mesh as meshlib
+from sml_tpu.parallel.dispatch import WorkHint
+
+
+@pytest.fixture
+def tunneled(monkeypatch):
+    """Pretend the process default backend is a tunneled TPU."""
+    monkeypatch.setattr(dispatch, "_default_backend", lambda: "tpu")
+    cal = dispatch._Calibration()
+    cal._done = True
+    cal.rt_fixed = 0.15          # s per dispatch+readback
+    cal.h2d_bw = 200e6           # bytes/s
+    cal.d2h_bw = 20e6
+    monkeypatch.setattr(dispatch, "CALIBRATION", cal)
+    yield cal
+
+
+def test_small_work_routes_host(tunneled):
+    route, promote = dispatch.decide(WorkHint(flops=1e8, kind="blas"))
+    assert route == "host" and not promote
+
+
+def test_large_work_routes_device(tunneled):
+    route, _ = dispatch.decide(WorkHint(flops=1e12, kind="blas"))
+    assert route == "device"
+
+
+def test_h2d_only_loss_requests_promotion(tunneled):
+    # device wins on flops (1e11/2e12=0.05 + rt 0.15 < 1e11/3e10=3.3) but
+    # loses once a 2GB staging transfer is charged
+    hint = WorkHint(flops=1e11, kind="blas", in_bytes=2e9)
+    route, promote = dispatch.decide(hint)
+    assert route == "host" and promote
+
+
+def test_mode_conf_overrides(tunneled):
+    GLOBAL_CONF.set("sml.dispatch.mode", "device")
+    try:
+        assert dispatch.decide(WorkHint(flops=1.0)) == ("device", False)
+        GLOBAL_CONF.set("sml.dispatch.mode", "host")
+        assert dispatch.decide(WorkHint(flops=1e15)) == ("host", False)
+    finally:
+        GLOBAL_CONF.set("sml.dispatch.mode", "auto")
+
+
+def test_no_hint_routes_device(tunneled):
+    assert dispatch.decide(None)[0] == "device"
+
+
+def test_cpu_backend_short_circuits(monkeypatch):
+    monkeypatch.setattr(dispatch, "_default_backend", lambda: "cpu")
+    assert dispatch.decide(WorkHint(flops=1.0))[0] == "device"
+
+
+def test_route_mesh_probes_staging_and_promotes(tunneled):
+    """Unstaged big input → host route + async promotion; once staged, the
+    same call routes device (the H2D term vanishes)."""
+    GLOBAL_CONF.set("sml.dispatch.autoPromote", True)
+    X = np.random.default_rng(0).normal(size=(4096, 64)).astype(np.float32)
+    # flops chosen so device wins iff no H2D charge (with the fake cal:
+    # host 1e10/3e10=0.33s; device 0.15 + 1e10/2e12=0.155s; +X/h2d≈+0.005…
+    # need bigger in_bytes influence, so shrink h2d_bw for this test
+    tunneled.h2d_bw = 2e6
+    hint = WorkHint(flops=1e10, kind="blas")
+    m1, r1 = _staging._route_mesh(hint, (X,))
+    assert r1 == "host" and dispatch.is_host_mesh(m1)
+    # the promotion staged X under the device mesh → second probe sees it
+    m2, r2 = _staging._route_mesh(hint, (X,))
+    assert r2 == "device" and m2 is meshlib.get_mesh()
+
+
+def test_bucket_rows_buckets_and_divides():
+    from sml_tpu.parallel.mesh import bucket_rows
+    for n_dev in (1, 4, 8):
+        prev = 0
+        for n in [1, 7, 100, 1000, 40_000, 48_000, 1_000_000]:
+            b = bucket_rows(n, n_dev)
+            assert b >= n and b % n_dev == 0
+            assert b <= max(1.125 * n, n + n_dev + 16)  # ≤12.5% padding
+            assert b >= prev
+            prev = b
+    # nearby sizes share a bucket (the compile-cache point of bucketing)
+    assert bucket_rows(40_000, 8) == bucket_rows(40_011, 8)
