@@ -51,6 +51,9 @@ _register("sml.default.parallelism", 8, int, "Default partition count for new da
 _register("sml.tpu.mesh.axis", "data", str, "Default 1-D mesh axis name")
 _register("sml.tpu.donate", True, _to_bool, "Donate input buffers on training steps")
 _register("sml.profiler.enabled", False, _to_bool, "Record op-level timings")
+_register("sml.applyInPandas.parallelism", 8, int,
+          "Concurrent per-group fn threads in applyInPandas; 1 = sequential "
+          "(needed only by fns that mutate shared closure state)")
 
 
 class TpuConf:

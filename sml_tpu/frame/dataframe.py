@@ -52,6 +52,15 @@ def _concat(parts: Partitions) -> pd.DataFrame:
 def coerce_to_schema(pdf: pd.DataFrame, schema: StructType) -> pd.DataFrame:
     """Project + cast a pandas block to a StructType (schema enforcement at
     pandas-fn boundaries, mirroring `mapInPandas`/`applyInPandas` contracts)."""
+    # fast path: already exactly conforming (the common case for UDFs that
+    # build their output frames from numpy results)
+    names = [f.name for f in schema.fields]
+    if list(pdf.columns) == names:
+        want = {"double": "float64", "float": "float32",
+                "bigint": "int64", "int": "int32", "boolean": "bool"}
+        if all(want.get(f.dataType.simpleString()) == str(pdf[f.name].dtype)
+               for f in schema.fields):
+            return pdf.reset_index(drop=True)
     out = {}
     for f in schema.fields:
         if f.name in pdf.columns:
@@ -84,6 +93,7 @@ class DataFrame:
         self._schema_hint = schema
         self._parts: Optional[Partitions] = None
         self._offsets: Optional[List[int]] = None
+        self._pdf_cache: Optional[pd.DataFrame] = None
         # ML column attributes (e.g. categorical cardinality set by
         # StringIndexer, per-slot metadata set by VectorAssembler) — the
         # equivalent of Spark ML's column metadata that tree learners read
@@ -211,7 +221,14 @@ class DataFrame:
         return self.count() == 0
 
     def toPandas(self) -> pd.DataFrame:
-        return _concat(self._materialize()).reset_index(drop=True)
+        """Concatenate all partitions; the result is memoized per frame.
+        Frames are immutable once materialized, and under pandas>=3
+        copy-on-write the returned shallow copy is mutation-safe for the
+        caller, so repeated toPandas (every pipeline stage fit calls it)
+        costs one concat total instead of one per call."""
+        if self._pdf_cache is None:
+            self._pdf_cache = _concat(self._materialize()).reset_index(drop=True)
+        return self._pdf_cache.copy(deep=False)
 
     def collect(self) -> List[Row]:
         pdf = self.toPandas()
@@ -275,7 +292,7 @@ class DataFrame:
         cc = ensure_column(col)
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             out[name] = cc._eval(pdf, ctx).reset_index(drop=True).values
             return out
 
@@ -318,7 +335,7 @@ class DataFrame:
 
     def toDF(self, *names: str) -> "DataFrame":
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             out.columns = list(names)
             return out
         return self._derive(fn)
@@ -334,7 +351,7 @@ class DataFrame:
 
     def fillna(self, value, subset: Optional[Sequence[str]] = None) -> "DataFrame":
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if isinstance(value, dict):
                 return out.fillna(value)
             cols = subset or out.columns
@@ -576,6 +593,7 @@ class DataFrame:
         if self._compute is not None:
             self._parts = None
             self._offsets = None
+        self._pdf_cache = None
         return self
 
     # ------------------------------------------------------------- stats

@@ -27,7 +27,10 @@ class GroupedData:
         self._keys = keys
 
     def _grouped(self):
-        pdf = _concat(self._df._materialize())
+        # toPandas, not a fresh concat: the frame memoizes its concat, so
+        # repeated grouped actions on a cached frame share one materialization
+        pdf = self._df.toPandas() if hasattr(self._df, "toPandas") \
+            else _concat(self._df._materialize())
         key_names = [k._name for k in self._keys]
         for k in self._keys:
             if k._name not in pdf.columns:
@@ -120,10 +123,23 @@ class GroupedData:
             pdf, key_names = parent._grouped()
             if len(pdf) == 0:
                 return [coerce_to_schema(pd.DataFrame(), sch)]
-            outs = []
-            for _, g in pdf.groupby(key_names, sort=False, dropna=False):
-                res = fn(g.reset_index(drop=True))
-                outs.append(coerce_to_schema(res, sch))
+            groups = [g.reset_index(drop=True) for _, g in
+                      pdf.groupby(key_names, sort=False, dropna=False)]
+            par = GLOBAL_CONF.getInt("sml.applyInPandas.parallelism")
+            if len(groups) > 1 and par > 1:
+                # per-group fns run concurrently, as on Spark executors
+                # (P8): sklearn/numpy payloads release the GIL in BLAS.
+                # NOTE these are threads of ONE interpreter — a fn that
+                # mutates shared closure state needs
+                # sml.applyInPandas.parallelism=1 (Spark's process-isolated
+                # workers could never share state in the first place)
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(
+                        max_workers=min(par, len(groups))) as ex:
+                    outs = [coerce_to_schema(r, sch)
+                            for r in ex.map(fn, groups)]
+            else:
+                outs = [coerce_to_schema(fn(g), sch) for g in groups]
             full = pd.concat(outs, ignore_index=True)
             nparts = min(len(outs), GLOBAL_CONF.getInt("sml.shuffle.partitions"))
             avail = [k for k in key_names if k in full.columns]

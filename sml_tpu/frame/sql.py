@@ -137,15 +137,40 @@ def run_sql(session: "TpuSession", query: str):
     # --- SELECT via sqlite -------------------------------------------------
     con = sqlite3.connect(":memory:")
     try:
+        # Time travel in SELECT (`ML 00c:184-209`): both the clause form
+        # `delta.`p` VERSION AS OF n` / `TIMESTAMP AS OF 'ts'` (also on
+        # registered table names) and the `delta.`p@vN` shorthand.
+        def repl_travel(m_):
+            target, kind, value = m_.group(1), m_.group(2), m_.group(3)
+            dm = _DELTA_REF.match(target)
+            path = dm.group(1) if dm else \
+                session.catalog._table_path(target.strip("`"))
+            key = "versionAsOf" if kind.lower().startswith("version") \
+                else "timestampAsOf"
+            from ..delta.table import read_delta
+            df = read_delta(path, session, {key: value.strip("'\"")})
+            tbl = "_tt_" + re.sub(r"\W", "_", f"{path}_{kind[0]}_{value}")
+            _to_sqlite(df.toPandas(), tbl, con)
+            return tbl
+
+        q2 = re.sub(
+            r"(delta\.`[^`]+`|[\w.`]+)\s+(version|timestamp)\s+as\s+of\s+"
+            r"('[^']*'|\"[^\"]*\"|\d+)", repl_travel, q, flags=re.I)
+
         # Materialize delta.`path` references as temp tables.
         def repl(m_):
             path = m_.group(1)
-            tbl = "_delta_" + re.sub(r"\W", "_", path)
             from ..delta.table import read_delta
-            _to_sqlite(read_delta(path, session, {}).toPandas(), tbl, con)
+            opts = {}
+            at = re.search(r"@v(\d+)$", path)
+            if at:  # delta.`path@vN` version shorthand
+                path = path[:at.start()]
+                opts["versionAsOf"] = int(at.group(1))
+            tbl = "_delta_" + re.sub(r"\W", "_", m_.group(1))
+            _to_sqlite(read_delta(path, session, opts).toPandas(), tbl, con)
             return tbl
 
-        q2 = _DELTA_REF.sub(repl, q)
+        q2 = _DELTA_REF.sub(repl, q2)
 
         for name, df in session.catalog._views().items():
             if re.search(rf"\b{re.escape(name)}\b", q2, re.I):

@@ -33,7 +33,12 @@ def extract_features(df, featuresCol: str) -> np.ndarray:
 
     Columnar `VectorArray` columns (VectorAssembler/OHE output) hand over
     their backing (n, d) block directly — no per-row objects on the staging
-    path (VERDICT r1 weak #3)."""
+    path (VERDICT r1 weak #3). A frame carrying a `_featurized` fast-path
+    block (attached by Pipeline's fused fit, see base.Pipeline._fit) hands
+    that over WITHOUT materializing its lazy transform chain at all."""
+    feat = getattr(df, "_featurized", None)
+    if feat is not None and featuresCol in feat:
+        return feat[featuresCol][0]
     pdf = df.toPandas() if hasattr(df, "toPandas") else df
     col = pdf[featuresCol]
     if isinstance(getattr(col, "array", None), VectorArray):
@@ -48,6 +53,20 @@ def extract_features(df, featuresCol: str) -> np.ndarray:
 
 def extract_xy(df, featuresCol: str, labelCol: str,
                weightCol: Optional[str] = None) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    feat = getattr(df, "_featurized", None)
+    if feat is not None and featuresCol in feat:
+        # fused-fit fast path: X was assembled in one columnar pass over
+        # the RAW frame; labels come from the same raw pandas (with the
+        # featurizer's row-drop mask applied) — the lazy transform chain
+        # never materializes
+        X, keep, raw_pdf = feat[featuresCol]
+        y = np.asarray(raw_pdf[labelCol], dtype=np.float32)
+        w = np.asarray(raw_pdf[weightCol], dtype=np.float32) if weightCol \
+            else None
+        if keep is not None:
+            y = y[keep]
+            w = w[keep] if w is not None else None
+        return X, y, w
     pdf = df.toPandas() if hasattr(df, "toPandas") else df
     X = extract_features(pdf, featuresCol)
     y = np.asarray(pdf[labelCol], dtype=np.float32)
@@ -175,6 +194,11 @@ def _route_mesh(hint, arrays, may_promote: bool = True) -> Tuple[object, str]:
     if pre is not None:  # no tunnel / forced mode: skip the probe entirely
         return (meshlib.get_mesh() if pre == "device"
                 else dispatch.host_mesh()), pre
+    resident = dispatch.WorkHint(hint.flops, hint.kind, hint.out_bytes, None)
+    if dispatch.decide(resident)[0] == "host":
+        # the device loses even with everything resident: no point hashing
+        # the arrays to price their H2D (hot on per-batch predict paths)
+        return dispatch.host_mesh(), "host"
     dev_mesh = meshlib.get_mesh()
     n_dev = dev_mesh.shape[meshlib.DATA_AXIS]
     eff = hint

@@ -255,7 +255,7 @@ class _TreeRegressionModel(_TreeModelBase):
         oc = self.getOrDefault("predictionCol")
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if len(out) == 0:
                 out[oc] = pd.Series(dtype=float)
                 return out
@@ -272,7 +272,7 @@ class _TreeClassificationModel(_TreeModelBase):
         prc = self.getOrDefault("probabilityCol")
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if len(out) == 0:
                 for c in (rc, prc):
                     out[c] = pd.Series(dtype=object)
@@ -298,8 +298,7 @@ class _TreeEstimatorBase(Estimator, _TreeParams):
     _loss = "squared"
 
     def _extract(self, df):
-        pdf = df.toPandas()
-        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
                              self.getOrDefault("labelCol"))
         ok = np.isfinite(y)
         return X[ok], y[ok], _categorical_slots(df, self.getOrDefault("featuresCol"))

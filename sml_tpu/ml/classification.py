@@ -62,8 +62,7 @@ class LogisticRegression(Estimator):
         return self._set(featuresCol=v)
 
     def _fit(self, df) -> "LogisticRegressionModel":
-        pdf = df.toPandas()
-        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
                              self.getOrDefault("labelCol"))
         ok = np.isfinite(y)
         X, y = X[ok], y[ok]
@@ -132,7 +131,7 @@ class LogisticRegressionModel(Model):
         w, b = self._coefficients, self._intercept
 
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if len(out) == 0:
                 for c in (rc, prc, pc):
                     out[c] = pd.Series(dtype=object if c != pc else float)

@@ -143,7 +143,7 @@ class KMeansModel(Model):
         centers = self._centers
 
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if len(out) == 0:
                 out[oc] = pd.Series(dtype=int)
                 return out

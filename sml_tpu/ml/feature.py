@@ -64,7 +64,7 @@ class VectorAssembler(Transformer):
 
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
             if len(pdf) == 0:
-                out = pdf.copy()
+                out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
                 out[out_col] = vector_series(np.zeros((0, 0)))
                 return out
             blocks = []
@@ -82,7 +82,7 @@ class VectorAssembler(Transformer):
             mat = np.concatenate(blocks, axis=1) if len(blocks) > 1 \
                 else blocks[0].copy()
             bad = ~np.isfinite(mat).all(axis=1)
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if bad.any():
                 if invalid == "error":
                     raise ValueError(
@@ -189,7 +189,7 @@ class StringIndexerModel(Model):
         maps = [{lab: float(i) for i, lab in enumerate(ls)} for ls in self.labelsArray]
 
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             keep_mask = np.ones(len(pdf), dtype=bool)
             for c, oc, mapping in zip(in_cols, out_cols, maps):
                 col = out[c]
@@ -243,7 +243,7 @@ class IndexToString(Transformer):
         ic, oc = self.getOrDefault("inputCol"), self.getOrDefault("outputCol")
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             out[oc] = out[ic].map(lambda i: labels[int(i)] if pd.notna(i) and
                                   int(i) < len(labels) else None)
             return out
@@ -301,7 +301,7 @@ class OneHotEncoderModel(Model):
         sizes = self.categorySizes
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             for c, oc, size in zip(in_cols, out_cols, sizes):
                 width = size - 1 if drop_last else size
                 idx = pd.to_numeric(out[c], errors="coerce").to_numpy(dtype=np.float64)
@@ -381,7 +381,7 @@ class ImputerModel(Model):
         surro = self.surrogates
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             for c, oc in zip(in_cols, out_cols):
                 s = pd.to_numeric(out[c], errors="coerce")
                 out[oc] = s.fillna(surro[c])
@@ -436,7 +436,7 @@ class StandardScalerModel(Model):
         mean, std = self.mean, np.where(self.std == 0, 1.0, self.std)
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             X = to_matrix(out[ic])   # zero-copy for columnar vector columns
             if with_mean:
                 X = X - mean
@@ -477,7 +477,7 @@ class Bucketizer(Transformer):
         ic, oc = self.getOrDefault("inputCol"), self.getOrDefault("outputCol")
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             x = pd.to_numeric(out[ic], errors="coerce").values
             idx = np.digitize(x, splits[1:-1], right=False).astype(float)
             idx[~np.isfinite(x)] = np.nan
@@ -563,7 +563,7 @@ class RFormulaModel(Model):
         src, dst = self.label_source, self._label_col
 
         def fn(pdf, ctx):
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if src in out.columns and dst != src:
                 out[dst] = pd.to_numeric(out[src], errors="coerce")
             return out

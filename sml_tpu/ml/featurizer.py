@@ -1,0 +1,357 @@
+"""Compiled columnar featurizer — the serving-path fusion pass.
+
+The reference's ML 12 lesson streams Arrow batches into a pyfunc whose
+sklearn pipeline re-runs preprocessing per batch
+(`SML/ML 12 - Inference with Pandas UDFs.py:101-143`). The generic path
+here does the same: each feature stage's pandas fn runs in sequence,
+allocating intermediate columns. For inference throughput that is pure
+overhead: the chain Imputer → StringIndexer → OneHotEncoder →
+VectorAssembler is a STATIC column program, so `CompiledFeaturizer`
+resolves it once at scorer build time into per-slot writers that scatter
+straight into ONE preallocated (n, d) float32 block — the exact layout
+`_staging` ships to the chip, with no intermediate frames, vector columns,
+or per-stage copies.
+
+Falls back to None (callers keep the generic path) for any stage or
+option outside the supported chain, so behavior never silently diverges.
+Supported: ImputerModel / StringIndexerModel (all handleInvalid modes,
+with "skip" dropping rows exactly like the stage) / OneHotEncoderModel /
+VectorAssembler(handleInvalid in ("error", "keep")).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+
+def _numeric(col) -> np.ndarray:
+    return pd.to_numeric(col, errors="coerce").to_numpy(dtype=np.float64,
+                                                        na_value=np.nan)
+
+
+class _Source:
+    """One resolved input column: writes its slot(s) of the output block."""
+
+    width = 1
+
+    def write(self, pdf: pd.DataFrame, out: np.ndarray, lo: int) -> None:
+        raise NotImplementedError
+
+
+class _NumericSource(_Source):
+    def __init__(self, col: str, fill: Optional[float] = None):
+        self.col = col
+        self.fill = fill  # imputer median/mean, applied on the fly
+
+    def write(self, pdf, out, lo):
+        v = _numeric(pdf[self.col])
+        if self.fill is not None:
+            v = np.where(np.isfinite(v), v, self.fill)
+        out[:, lo] = v
+
+
+class _IndexSource(_Source):
+    """StringIndexerModel output: label → ordinal, with the stage's exact
+    handleInvalid semantics (error raises; keep maps to len(labels); skip
+    marks the row for dropping via the featurizer-level mask)."""
+
+    def __init__(self, col: str, labels: np.ndarray, invalid: str):
+        self.col = col
+        self.labels = pd.Index(labels)
+        self.invalid = invalid
+        self._idx_by_dtype = {}  # dtype str -> Index in the COLUMN's dtype
+
+    def _index_for(self, col: pd.Series) -> pd.Index:
+        """get_indexer against an Index in the column's own dtype skips the
+        per-batch arrow→object conversion (~2x on arrow-string batches)."""
+        key = str(col.dtype)
+        idx = self._idx_by_dtype.get(key)
+        if idx is None:
+            try:
+                idx = pd.Index(pd.array([str(v) for v in self.labels],
+                                        dtype=col.dtype))
+            except Exception:
+                idx = self.labels
+            self._idx_by_dtype[key] = idx
+        return idx
+
+    def codes(self, pdf) -> np.ndarray:
+        """float codes with NaN for missing/unseen (pre-handleInvalid)."""
+        col = pdf[self.col]
+        notna = col.notna().to_numpy()
+        try:
+            c = self._index_for(col).get_indexer(col)
+        except Exception:
+            c = self.labels.get_indexer(col.astype(str).to_numpy(dtype=object))
+        c = c.astype(np.float64)
+        c[(c < 0) | ~notna] = np.nan
+        return c
+
+    def resolve(self, pdf, drop_mask) -> np.ndarray:
+        c = self.codes(pdf)
+        missing = ~np.isfinite(c)
+        if missing.any():
+            if self.invalid == "error":
+                bad = pdf[self.col][missing].iloc[0]
+                raise ValueError(f"Unseen label {bad!r} in column "
+                                 f"{self.col!r} (handleInvalid='error')")
+            if self.invalid == "skip":
+                drop_mask |= missing
+            else:  # keep
+                c[missing] = float(len(self.labels))
+        return c
+
+    def write(self, pdf, out, lo, drop_mask=None):
+        out[:, lo] = self.resolve(
+            pdf, drop_mask if drop_mask is not None
+            else np.zeros(len(pdf), dtype=bool))
+
+
+class _OneHotSource(_Source):
+    """OneHotEncoderModel over an indexed (or raw numeric-code) column."""
+
+    def __init__(self, inner, width: int):
+        self.inner = inner  # _IndexSource or _NumericSource
+        self.width = int(width)
+
+    def write(self, pdf, out, lo, drop_mask=None):
+        if isinstance(self.inner, _IndexSource):
+            idx = self.inner.resolve(
+                pdf, drop_mask if drop_mask is not None
+                else np.zeros(len(pdf), dtype=bool))
+        else:
+            idx = _numeric(pdf[self.inner.col])
+            if self.inner.fill is not None:  # Imputer feeding the encoder
+                idx = np.where(np.isfinite(idx), idx, self.inner.fill)
+        na = ~np.isfinite(idx)
+        ok = ~na & (idx >= 0) & (idx < self.width)
+        rows = np.nonzero(ok)[0]
+        out[:, lo:lo + self.width] = 0.0
+        out[rows, lo + idx[ok].astype(np.intp)] = 1.0
+        if na.any():  # matches OneHotEncoderModel: NaN input → NaN row
+            out[na, lo:lo + self.width] = np.nan
+
+
+class CompiledFeaturizer:
+    """Fused replacement for a feature-stage chain; see module docstring."""
+
+    def __init__(self, sources: List[_Source], handle_invalid: str):
+        self.sources = sources
+        self.handle_invalid = handle_invalid
+        self.width = sum(s.width for s in sources)
+
+    @classmethod
+    def from_stages(cls, stages, assembler) -> Optional["CompiledFeaturizer"]:
+        from .feature import (ImputerModel, OneHotEncoder,
+                              OneHotEncoderModel, StringIndexer,
+                              StringIndexerModel, VectorAssembler)
+        if not isinstance(assembler, VectorAssembler):
+            return None
+        invalid = assembler.getOrDefault("handleInvalid")
+        if invalid not in ("error", "keep"):
+            return None  # assembler "skip" drops by finiteness, not label
+
+        producers = {}  # intermediate column name -> _Source
+        for st in stages:
+            if st is assembler:
+                continue
+            if isinstance(st, ImputerModel):
+                ins = list(st.getOrDefault("inputCols") or [])
+                outs = list(st.getOrDefault("outputCols") or ins)
+                if any(c in producers for c in ins):
+                    return None  # imputing a produced column: generic path
+                for c, oc in zip(ins, outs):
+                    producers[oc] = _NumericSource(c, float(st.surrogates[c]))
+            elif isinstance(st, StringIndexerModel):
+                ins, outs = StringIndexer._in_out(st)
+                mode = st.getOrDefault("handleInvalid")
+                if any(c in producers for c in ins):
+                    return None  # indexing a produced column: generic path
+                for c, oc, labels in zip(ins, outs, st.labelsArray):
+                    producers[oc] = _IndexSource(
+                        c, np.asarray(labels, dtype=object), mode)
+            elif isinstance(st, OneHotEncoderModel):
+                ins, outs = OneHotEncoder._in_out(st)
+                drop_last = bool(st.getOrDefault("dropLast"))
+                for c, oc, size in zip(ins, outs, st.categorySizes):
+                    width = size - 1 if drop_last else size
+                    inner = producers.get(c) or _NumericSource(c)
+                    producers[oc] = _OneHotSource(inner, width)
+            else:
+                return None  # unknown stage: keep the generic path
+
+        sources: List[_Source] = []
+        for c in assembler.getOrDefault("inputCols"):
+            sources.append(producers.get(c) or _NumericSource(c))
+        return cls(sources, invalid)
+
+    def transform_with_mask(self, pdf: pd.DataFrame):
+        """(X, keep): the assembled block and the row-keep mask (None when
+        no StringIndexer 'skip' drops happened) — callers that pair X with
+        labels from the RAW frame must apply the same mask."""
+        out = np.empty((len(pdf), self.width), dtype=np.float32)
+        drop = np.zeros(len(pdf), dtype=bool)
+        # contiguous runs of plain numeric sources extract as ONE pandas
+        # block instead of a per-column to_numeric each (hot per batch)
+        runs = []
+        lo = 0
+        for s in self.sources:
+            simple = type(s) is _NumericSource
+            if simple and runs and runs[-1][-1][0] + runs[-1][-1][1].width \
+                    == lo and type(runs[-1][-1][1]) is _NumericSource:
+                runs[-1].append((lo, s))
+            elif simple:
+                runs.append([(lo, s)])
+            lo += s.width
+        done = set()
+        for run in runs:
+            if len(run) < 2:
+                continue
+            cols = [s.col for _, s in run]
+            fills = np.asarray([np.nan if s.fill is None else s.fill
+                                for _, s in run])
+            try:
+                block = pdf[cols].to_numpy(np.float64, na_value=np.nan)
+            except (TypeError, ValueError):  # non-numeric storage: coerce
+                block = pdf[cols].apply(
+                    lambda c: pd.to_numeric(c, errors="coerce")).to_numpy(
+                    np.float64, na_value=np.nan)
+            block = np.where(np.isfinite(block), block, fills[None, :])
+            out[:, run[0][0]:run[0][0] + len(run)] = block
+            done.update(id(s) for _, s in run)
+        lo = 0
+        for s in self.sources:
+            if id(s) in done:
+                pass
+            elif isinstance(s, (_IndexSource, _OneHotSource)):
+                s.write(pdf, out, lo, drop)
+            else:
+                s.write(pdf, out, lo)
+            lo += s.width
+        keep = None
+        if drop.any():  # StringIndexer handleInvalid="skip" row drops
+            keep = ~drop
+            out = out[keep]
+        if self.handle_invalid == "error" and not np.isfinite(out).all():
+            raise ValueError(
+                "VectorAssembler found NaN/null in assembled features; set "
+                "handleInvalid='skip' or impute first")
+        return out, keep
+
+    def __call__(self, pdf: pd.DataFrame) -> np.ndarray:
+        return self.transform_with_mask(pdf)[0]
+
+
+def try_fast_fit(stages, raw_pdf, make_frame):
+    """Whole-pipeline fused FIT: for the standard course chain
+    [Imputer?, StringIndexer?, OneHotEncoder?, VectorAssembler, estimator],
+    fit every prep stage from the RAW pandas (their inputs are raw columns),
+    derive OneHotEncoder sizes from the indexer's labels (`max(idx)+1 ==
+    len(labels)` when labels come from the same data), reconstruct the
+    assembler's slot metadata analytically, and hand the estimator a frame
+    carrying the one-pass assembled block — NO transform chain ever
+    materializes. Returns (fitted_prep_stages, estimator_input_frame) or
+    None (caller falls back to the generic sequential fit, which is always
+    correct); the caller runs the estimator fit itself so estimator errors
+    propagate unmasked.
+    """
+    from .base import Estimator
+    from .feature import (Imputer, OneHotEncoder, OneHotEncoderModel,
+                          StringIndexer, VectorAssembler)
+    if len(stages) < 2 or raw_pdf is None:
+        return None
+    *prep, est = stages
+    if not isinstance(est, Estimator):
+        return None
+    if not (est.hasParam("featuresCol") and est.hasParam("labelCol")):
+        return None
+    if not prep or not isinstance(prep[-1], VectorAssembler):
+        return None
+    assembler = prep[-1]
+    if est.getOrDefault("featuresCol") != assembler.getOrDefault("outputCol"):
+        return None
+    if est.getOrDefault("labelCol") not in raw_pdf.columns:
+        return None
+    produced = set()
+    for st in prep[:-1]:
+        for attr in ("outputCols", "outputCol"):
+            try:
+                v = st.getOrDefault(attr)
+            except Exception:
+                v = None
+            if isinstance(v, str):
+                produced.add(v)
+            elif v:
+                produced.update(v)
+    label_like = {est.getOrDefault("labelCol")}
+    if est.hasParam("weightCol"):
+        w = est.getOrDefault("weightCol")
+        if w:
+            label_like.add(w)
+    if produced & label_like:
+        return None  # a prep stage rewrites the label: raw labels are wrong
+
+    raw_frame = make_frame(raw_pdf)
+    fitted = []
+    attrs = {}          # column -> ml attrs (categorical cardinalities)
+    idx_labels = {}     # indexer output col -> label list
+    ohe_widths = {}     # ohe output col -> vector width
+    for st in prep[:-1]:
+        if isinstance(st, Imputer):
+            ins = list(st.getOrDefault("inputCols") or [])
+            if any(c not in raw_pdf.columns for c in ins):
+                return None
+            fitted.append(st.fit(raw_frame))
+        elif isinstance(st, StringIndexer):
+            ins, outs = st._in_out()
+            if any(c not in raw_pdf.columns for c in ins):
+                return None
+            m = st.fit(raw_frame)
+            extra = 1 if st.getOrDefault("handleInvalid") == "keep" else 0
+            for oc, ls in zip(outs, m.labelsArray):
+                idx_labels[oc] = ls
+                attrs[oc] = {"categorical": len(ls) + extra}
+            fitted.append(m)
+        elif isinstance(st, OneHotEncoder):
+            ins, outs = st._in_out()
+            if any(c not in idx_labels for c in ins):
+                return None  # OHE over a non-indexer column: generic path
+            sizes = [len(idx_labels[c]) for c in ins]
+            m = OneHotEncoderModel(categorySizes=sizes)
+            m._inherit_params(st)
+            drop_last = bool(m.getOrDefault("dropLast"))
+            for oc, size in zip(outs, sizes):
+                ohe_widths[oc] = size - 1 if drop_last else size
+            fitted.append(m)
+        else:
+            return None
+    fitted.append(assembler)
+
+    feat = CompiledFeaturizer.from_stages(fitted[:-1], assembler)
+    if feat is None:
+        return None
+    # the assembler's slot metadata (VectorAssembler._transform computes
+    # this from column attrs + row peeks; here widths are known statically)
+    slots, pos = {}, 0
+    for c in assembler.getOrDefault("inputCols"):
+        if c in attrs and "categorical" in attrs[c]:
+            slots[pos] = int(attrs[c]["categorical"])
+            pos += 1
+        elif c in ohe_widths:
+            pos += ohe_widths[c]
+        else:
+            pos += 1
+    out_col = assembler.getOrDefault("outputCol")
+
+    X, keep = feat.transform_with_mask(raw_pdf)
+    shim = make_frame(raw_pdf)
+    shim._ml_attrs = dict(attrs)
+    shim._ml_attrs[out_col] = {"slots": slots, "numFeatures": pos}
+    shim._featurized = {out_col: (X, keep, raw_pdf)}
+    # the ESTIMATOR fit happens in the caller, OUTSIDE any fallback guard:
+    # its errors (bad hyperparameters, device OOM) must propagate, not
+    # trigger a silent re-fit through the generic path
+    return fitted, shim

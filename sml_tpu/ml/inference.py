@@ -121,6 +121,17 @@ class DeviceScorer:
             tail = stages[-1]
         self._model = tail
         self._kind, self._params = self._compile_target(tail)
+        # fuse the feature chain into one columnar pass when its shape is
+        # the supported Imputer/StringIndexer/OHE/VectorAssembler program
+        self._featurizer = None
+        if self._stages:
+            from .feature import VectorAssembler
+            from .featurizer import CompiledFeaturizer
+            last = self._stages[-1]
+            if isinstance(last, VectorAssembler) and \
+                    last.getOrDefault("outputCol") == self.featuresCol:
+                self._featurizer = CompiledFeaturizer.from_stages(
+                    self._stages[:-1], last)
 
     @staticmethod
     def _compile_target(model):
@@ -212,10 +223,20 @@ class DeviceScorer:
     def _prep(self, pdf) -> np.ndarray:
         if isinstance(pdf, np.ndarray):
             return pdf
-        from ..frame.session import get_session
+        if self._featurizer is not None:
+            try:
+                return self._featurizer(pdf)
+            except KeyError:
+                # a column the compiled chain assumed raw isn't in this
+                # batch: permanently fall back to the generic stage path
+                self._featurizer = None
         cur = pdf
         if self._stages:
-            df = get_session().createDataFrame(cur)
+            # single-partition wrap: stage fns run ONCE per batch — routing
+            # a 10k-row batch through the session's default 8-way split ran
+            # every stage 8x and dominated the ML 12 leg
+            from ..frame.dataframe import DataFrame as _DF
+            df = _DF.from_partitions([pdf])
             for s in self._stages:
                 df = s.transform(df)
             cur = df.toPandas()
@@ -223,25 +244,45 @@ class DeviceScorer:
 
     def score_batches(self, batches: Iterable,
                       depth: int = 4) -> Iterator[np.ndarray]:
-        """Pipeline an iterator of pandas batches through the device: up to
-        `depth` batches are dispatched ahead with async host copies started
-        at dispatch, so H2D staging, device compute, and D2H transfers all
-        overlap — the device→host latency is paid ~once, not per batch."""
+        """Pipeline an iterator of pandas batches through the scorer:
+        feature prep for upcoming batches runs on worker threads (pandas /
+        numpy release the GIL in their C paths) while the current batch's
+        math executes, and on the device route up to `depth` batches are
+        dispatched ahead with async host copies started at dispatch — prep,
+        H2D staging, device compute, and D2H transfers all overlap."""
         from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
         pending: deque = deque()
 
         def drain_one():
             out, n, fin = pending.popleft()
             return fin(np.asarray(out, dtype=np.float64)[:n])
 
-        for b in batches:
-            out, n, fin = self._dispatch(self._prep(b))
-            try:
-                out.copy_to_host_async()
-            except Exception:
-                pass
-            pending.append((out, n, fin))
-            if len(pending) >= depth:
+        workers = 4
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            it = iter(batches)
+            preps: deque = deque()
+
+            def submit_next() -> bool:
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return False
+                preps.append(ex.submit(self._prep, b))
+                return True
+
+            for _ in range(workers):
+                submit_next()
+            while preps:
+                X = preps.popleft().result()
+                submit_next()
+                out, n, fin = self._dispatch(X)
+                try:
+                    out.copy_to_host_async()
+                except Exception:
+                    pass
+                pending.append((out, n, fin))
+                if len(pending) >= depth:
+                    yield drain_one()
+            while pending:
                 yield drain_one()
-        while pending:
-            yield drain_one()

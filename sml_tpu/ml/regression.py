@@ -82,8 +82,9 @@ class LinearRegression(Estimator, _PredictorParams):
         return self._set(featuresCol=v)
 
     def _fit(self, df) -> "LinearRegressionModel":
-        pdf = df.toPandas()
-        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+        # pass the FRAME, not a pandas copy: extract_xy short-circuits on a
+        # fused-fit featurized block without materializing the chain
+        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
                              self.getOrDefault("labelCol"))
         ok = np.isfinite(y)
         X, y = X[ok], y[ok]
@@ -162,7 +163,7 @@ class LinearRegressionModel(Model, _PredictorParams):
         w, b = self._coefficients, self._intercept
 
         def fn(pdf: pd.DataFrame, ctx) -> pd.DataFrame:
-            out = pdf.copy()
+            out = pdf.copy(deep=False)  # CoW: column adds never touch the parent
             if len(out) == 0:
                 out[oc] = pd.Series(dtype=float)
                 return out

@@ -47,6 +47,64 @@ def reset_option(key: str) -> None:
     _options[key] = defaults[key]
 
 
+class _OptionsNamespace:
+    """`ks.options.plotting.backend = 'matplotlib'` attribute surface over
+    the dotted option keys (`ML 14:180`)."""
+
+    def __init__(self, prefix: str = ""):
+        object.__setattr__(self, "_prefix", prefix)
+
+    def _key(self, item: str) -> str:
+        return f"{self._prefix}{item}" if not self._prefix else \
+            f"{self._prefix}.{item}"
+
+    def __getattr__(self, item):
+        key = self._key(item)
+        if key in _options:
+            return _options[key]
+        if any(k.startswith(key + ".") for k in _options):
+            return _OptionsNamespace(key)
+        raise AttributeError(key)
+
+    def __setattr__(self, item, value):
+        set_option(self._key(item), value)
+
+
+options = _OptionsNamespace()
+
+
+class _PlotAccessor:
+    """`kdf.plot.hist(...)` / called directly `kdf.plot(...)` — delegates to
+    pandas plotting on the collected data (`ML 14:181-186`)."""
+
+    def __init__(self, obj):
+        self._obj = obj
+
+    def _pandas(self):
+        return self._obj.to_pandas()
+
+    def __call__(self, *a, **kw):
+        return self._pandas().plot(*a, **kw)
+
+    def hist(self, x=None, y=None, bins: int = 10, **kw):
+        pdf = self._pandas()
+        if isinstance(pdf, pd.Series):
+            return pdf.plot.hist(bins=bins, **kw)
+        cols = [c for c in (x, y) if c is not None and c in pdf.columns]
+        if cols:
+            pdf = pdf[cols]
+        return pdf.plot.hist(bins=bins, **kw)
+
+    def __getattr__(self, kind):
+        if kind.startswith("_"):
+            raise AttributeError(kind)
+
+        def run(*a, **kw):
+            return getattr(self._pandas().plot, kind)(*a, **kw)
+
+        return run
+
+
 class _InternalFrame:
     """(distributed frame, index column) — updates swap metadata, not data."""
 
@@ -167,8 +225,9 @@ class Series:
         name = {float: "double", int: "bigint", str: "string"}.get(dtype, str(dtype))
         return self._binop(None, lambda a, b: a.cast(name))
 
-    def plot(self, *a, **kw):
-        return self.to_pandas().plot(*a, **kw)
+    @property
+    def plot(self) -> _PlotAccessor:
+        return _PlotAccessor(self)
 
     @property
     def hist(self):
@@ -302,8 +361,28 @@ class DataFrame:
     def groupby(self, by) -> "GroupBy":
         return GroupBy(self, [by] if isinstance(by, str) else list(by))
 
-    def plot(self, *a, **kw):
-        return self.to_pandas().plot(*a, **kw)
+    def filter(self, items=None, like=None, regex=None) -> "DataFrame":  # noqa: A003
+        """Column subsetting à la pandas (`ML 14:185` uses filter(items=…))."""
+        cols = self._internal.data_columns
+        if items is not None:
+            keep = [c for c in cols if c in set(items)]
+        elif like is not None:
+            keep = [c for c in cols if like in c]
+        elif regex is not None:
+            import re as _re
+            keep = [c for c in cols if _re.search(regex, c)]
+        else:
+            raise TypeError("filter requires items, like, or regex")
+        sel = list(keep)
+        if self._internal.index_col and \
+                self._internal.index_col in self._internal.sdf.columns:
+            sel.append(self._internal.index_col)  # carry the index through
+        return DataFrame(_InternalFrame(self._internal.sdf.select(sel),
+                                        self._internal.index_col))
+
+    @property
+    def plot(self) -> _PlotAccessor:
+        return _PlotAccessor(self)
 
     def to_delta(self, path: str, mode: str = "overwrite") -> None:
         self._internal.sdf.write.format("delta").mode(mode).save(path)

@@ -53,11 +53,11 @@ _register("sml.dispatch.autoPromote", True, _to_bool,
 # (over-crediting the host only steers SMALL jobs hostward, where the fixed
 # device latency dominates any estimation error)
 _HOST_RATES = {
-    "blas": 3e10,      # dense matmul-shaped work (Gram, forward passes)
-    "scatter": 1.5e9,  # histogram/one-hot accumulation, tree traversal
-    #                    (measured: ensemble fit at 48k rows = 1.2e9 on the
-    #                    host mesh)
-    "scan": 1.2e9,     # long sequential scans (boosting rounds, ARIMA)
+    # measured on THIS host's 1-device mesh (XLA:CPU): Gram at 2M rows ran
+    # 3.8e9 flops in ~0.7s; the ensemble one-hot program 4.6e9 in ~3.8s
+    "blas": 6e9,       # dense matmul-shaped work (Gram, forward passes)
+    "scatter": 1.2e9,  # histogram/one-hot accumulation, tree traversal
+    "scan": 1.0e9,     # long sequential scans (boosting rounds, ARIMA)
 }
 _DEVICE_RATE = 2e12  # sustained non-MXU-peak device throughput estimate
 
@@ -192,8 +192,11 @@ def decide(hint: Optional[WorkHint]) -> Tuple[str, bool]:
     t_host = host_time(hint)
     if device_time(hint, cal) <= t_host:
         return "device", False
+    # Promote only on a DECISIVE resident-device win: flipping a dataset's
+    # route costs a fresh trace/compile of every program it touches, so a
+    # marginal (<3x) projected gain is not worth the switch.
     resident = WorkHint(hint.flops, hint.kind, hint.out_bytes, None)
-    return "host", device_time(resident, cal) <= t_host
+    return "host", 3.0 * device_time(resident, cal) <= t_host
 
 
 def mesh_for(hint: Optional[WorkHint]):

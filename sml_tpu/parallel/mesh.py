@@ -189,16 +189,36 @@ def mesh_device_count(mesh: Optional[Mesh] = None) -> int:
     return math.prod(mesh.devices.shape)
 
 
+PLACEMENT_LOG: list = []  # (trial_index, device_id tuple) per placed trial
+_PLACEMENT_LOG_MAX = 4096
+
+
+def _log_placement(idx: int, mesh: Mesh) -> None:
+    with _lock:
+        if len(PLACEMENT_LOG) >= _PLACEMENT_LOG_MAX:
+            del PLACEMENT_LOG[: _PLACEMENT_LOG_MAX // 2]
+        PLACEMENT_LOG.append((idx, tuple(d.id for d in mesh.devices.flat)))
+
+
 def run_placed_trials(jobs: Sequence, fn, parallelism: int) -> list:
     """Run `fn(job)` for every job with REAL chip placement: `parallelism`
     worker threads, each bound (thread-locally) to its own disjoint submesh
     of the active mesh, so concurrent trials execute on different chips —
     the TPU replacement for Spark's driver thread pool + executor tasks
-    (`SML/ML 07:120-130`, `SML/Labs/ML 08L:89-107`)."""
+    (`SML/ML 07:120-130`, `SML/Labs/ML 08L:89-107`).
+
+    Every trial's placement is recorded in `PLACEMENT_LOG` (trial index →
+    submesh device ids), so placement is ASSERTABLE without wall-clock
+    timing (VERDICT r2 #7)."""
     jobs = list(jobs)
     parallelism = max(1, int(parallelism))
     if parallelism <= 1 or len(jobs) <= 1:
-        return [fn(j) for j in jobs]
+        mesh = get_mesh()
+        out = []
+        for i, j in enumerate(jobs):
+            _log_placement(i, mesh)
+            out.append(fn(j))
+        return out
     from concurrent.futures import ThreadPoolExecutor
     import queue as _queue
 
@@ -210,6 +230,11 @@ def run_placed_trials(jobs: Sequence, fn, parallelism: int) -> list:
     def bind_submesh():
         _tls.mesh = q.get_nowait()
 
+    def run_one(args):
+        i, job = args
+        _log_placement(i, _tls.mesh)
+        return fn(job)
+
     with ThreadPoolExecutor(max_workers=parallelism,
                             initializer=bind_submesh) as pool:
-        return list(pool.map(fn, jobs))
+        return list(pool.map(run_one, enumerate(jobs)))

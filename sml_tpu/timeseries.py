@@ -379,11 +379,16 @@ class ARIMA:
         p, d, q = self.order
         if d == 0:
             return fitted_diff
-        # integrate fitted differences back to levels
-        base = self._orig[d - 1:-1] if d == 1 else None
-        if d == 1:
-            return self._orig[:-1] + fitted_diff
-        raise NotImplementedError("predict supports d<=1")
+        # one-step-ahead in levels: Δᵈy_t = Σ_{k=0..d} (-1)^k C(d,k) y_{t-k}
+        # ⇒ ŷ_t = ŵ_t + Σ_{k=1..d} (-1)^{k+1} C(d,k) y_{t-k}, using ACTUAL
+        # history (the statsmodels in-sample predict convention). Covers any
+        # d — the course's ARIMA(1,2,1) needs d=2 (`MLE 04:280-320`).
+        from math import comb
+        n = len(self._orig)
+        hist = np.zeros(n - d)
+        for k in range(1, d + 1):
+            hist += ((-1) ** (k + 1)) * comb(d, k) * self._orig[d - k:n - k]
+        return hist + fitted_diff
 
     def _forecast(self, params, steps: int) -> np.ndarray:
         p, d, q = self.order
@@ -402,11 +407,11 @@ class ARIMA:
         out = np.asarray(out)
         if d == 0:
             return out
-        if d == 1:
-            return self._orig[-1] + np.cumsum(out)
-        last = self._orig[-d:]
-        for _ in range(d):
-            out = np.cumsum(out) + last[-1]
+        # invert one difference at a time: `out` holds forecasts of Δʲy;
+        # seed each integration with the last OBSERVED value of Δ^{j-1}y
+        for j in range(d, 0, -1):
+            prev = np.diff(self._orig, n=j - 1) if j > 1 else self._orig
+            out = prev[-1] + np.cumsum(out)
         return out
 
 

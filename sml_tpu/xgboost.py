@@ -57,10 +57,9 @@ class _XgboostBase(_TreeEstimatorBase, _XgboostParams):
                 raise TypeError(f"unexpected param {k!r}")
 
     def _fit(self, df):
-        pdf = df.toPandas()
         from .ml._staging import extract_xy
         import numpy as np
-        X, y, _ = extract_xy(pdf, self.getOrDefault("featuresCol"),
+        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
                              self.getOrDefault("labelCol"))
         ok = np.isfinite(y)
         X, y = X[ok], y[ok]

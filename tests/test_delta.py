@@ -99,3 +99,27 @@ def test_save_as_table(spark, tmp_path):
     back = spark.table("t_test")
     assert back.count() == 100
     assert spark.catalog.tableExists("t_test")
+
+
+def test_sql_version_as_of(spark, tmp_path):
+    """SELECT-level time travel (`ML 00c:184-209`): VERSION AS OF,
+    TIMESTAMP AS OF, and the delta.`path@vN` shorthand."""
+    import pandas as pd
+    p = str(tmp_path / "tt")
+    spark.createDataFrame(pd.DataFrame({"x": [1, 2]})) \
+        .write.format("delta").mode("overwrite").save(p)
+    spark.createDataFrame(pd.DataFrame({"x": [10, 20, 30]})) \
+        .write.format("delta").mode("overwrite").save(p)
+
+    v0 = spark.sql(f"SELECT * FROM delta.`{p}` VERSION AS OF 0").toPandas()
+    assert sorted(v0["x"].tolist()) == [1, 2]
+    v1 = spark.sql(f"SELECT * FROM delta.`{p}` VERSION AS OF 1").toPandas()
+    assert sorted(v1["x"].tolist()) == [10, 20, 30]
+    sh = spark.sql(f"SELECT count(*) AS n FROM delta.`{p}@v0`").toPandas()
+    assert int(sh["n"].iloc[0]) == 2
+
+    hist = spark.sql(f"DESCRIBE HISTORY delta.`{p}`").toPandas()
+    ts = str(hist["timestamp"].max())
+    vt = spark.sql(
+        f"SELECT * FROM delta.`{p}` TIMESTAMP AS OF '{ts}'").toPandas()
+    assert sorted(vt["x"].tolist()) == [10, 20, 30]

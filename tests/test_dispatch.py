@@ -40,9 +40,9 @@ def test_large_work_routes_device(tunneled):
 
 
 def test_h2d_only_loss_requests_promotion(tunneled):
-    # device wins on flops (1e11/2e12=0.05 + rt 0.15 < 1e11/3e10=3.3) but
-    # loses once a 2GB staging transfer is charged
-    hint = WorkHint(flops=1e11, kind="blas", in_bytes=2e9)
+    # device wins decisively on flops (0.15 + 1e11/2e12 = 0.2s vs host
+    # 1e11/6e9 = 16.7s) but loses once a 10GB staging transfer is charged
+    hint = WorkHint(flops=1e11, kind="blas", in_bytes=1e10)
     route, promote = dispatch.decide(hint)
     assert route == "host" and promote
 
@@ -71,11 +71,11 @@ def test_route_mesh_probes_staging_and_promotes(tunneled):
     same call routes device (the H2D term vanishes)."""
     GLOBAL_CONF.set("sml.dispatch.autoPromote", True)
     X = np.random.default_rng(0).normal(size=(4096, 64)).astype(np.float32)
-    # flops chosen so device wins iff no H2D charge (with the fake cal:
-    # host 1e10/3e10=0.33s; device 0.15 + 1e10/2e12=0.155s; +X/h2d≈+0.005…
-    # need bigger in_bytes influence, so shrink h2d_bw for this test
-    tunneled.h2d_bw = 2e6
-    hint = WorkHint(flops=1e10, kind="blas")
+    # flops chosen so the device wins decisively once resident (host
+    # 5e9/6e9 = 0.83s vs resident 0.15s) but loses while X's ~1MB H2D is
+    # charged at the test's 1MB/s bandwidth (+1.05s)
+    tunneled.h2d_bw = 1e6
+    hint = WorkHint(flops=5e9, kind="blas")
     m1, r1 = _staging._route_mesh(hint, (X,))
     assert r1 == "host" and dispatch.is_host_mesh(m1)
     # the promotion staged X under the device mesh → second probe sees it

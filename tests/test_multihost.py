@@ -1,0 +1,60 @@
+"""Cross-host (DCN) bring-up smoke: `collectives.initialize_multihost`
+actually wires `jax.distributed` so named collectives span processes
+(SURVEY §2.4 — the NCCL/MPI-equivalent bootstrap). Runs UNCONDITIONALLY
+on two local CPU processes (VERDICT r2 #7)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+os.environ.pop("XLA_FLAGS", None)  # 1 device per process: DCN, not fake ICI
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sml_tpu.parallel import collectives
+pid = int(sys.argv[1])
+collectives.initialize_multihost(coordinator="127.0.0.1:{port}",
+                                 num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2, jax.device_count()
+# a psum across BOTH processes' devices: each contributes (pid+1)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+local = np.asarray([float(pid + 1)])
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local, (2,))
+f = jax.jit(shard_map(lambda x: collectives.psum(x, "data"), mesh=mesh,
+                      in_specs=P("data"), out_specs=P(),
+                      check_vma=False))
+out = f(arr)
+total = float(np.asarray(jax.device_get(out.addressable_shards[0].data))[0])
+assert total == 3.0, total  # 1 + 2 over DCN
+print(f"proc {{pid}} psum-over-hosts ok: {{total}}")
+"""
+
+
+def test_initialize_multihost_two_process_psum(tmp_path):
+    with socket.socket() as s:  # find a free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = _WORKER.format(repo=REPO, port=port)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert "psum-over-hosts ok: 3.0" in out

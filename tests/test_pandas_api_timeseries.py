@@ -144,3 +144,54 @@ def test_holt_methods():
     assert fc_damped[-1] < fc[-1]
     ses = SimpleExpSmoothing(y).fit(smoothing_level=0.3)
     assert len(ses.fittedvalues) == len(y)
+
+
+def test_arima_d2_fitted_and_forecast():
+    """The course's exact elective model is ARIMA(1,2,1) (`MLE 04:280-320`);
+    d=2 in-sample predict must produce finite level-space values that track
+    a quadratic-trend series, and forecasts must continue the trend."""
+    t = np.arange(120, dtype=float)
+    rng = np.random.default_rng(0)
+    y = 0.05 * t * t + 2 * t + 10 + rng.normal(scale=0.5, size=len(t))
+    res = ARIMA(y, order=(1, 2, 1)).fit()
+    fitted = res.predict()
+    assert fitted.shape == (len(y) - 2,)
+    assert np.isfinite(fitted).all()
+    # one-step-ahead predictions in LEVELS should track closely
+    err = np.abs(fitted - y[2:])
+    assert np.median(err) < 2.0
+    fc = res.forecast(5)
+    assert fc.shape == (5,) and np.isfinite(fc).all()
+    # a quadratic trend keeps rising: forecasts continue beyond the last level
+    assert fc[-1] > y[-1]
+    assert np.all(np.diff(fc) > 0)
+
+
+def test_arima_d1_fitted_matches_manual_integration():
+    rng = np.random.default_rng(1)
+    y = np.cumsum(1.0 + rng.normal(scale=0.3, size=80)) + 5
+    res = ARIMA(y, order=(1, 1, 0)).fit()
+    fitted = res.fittedvalues
+    assert fitted.shape == (len(y) - 1,)
+    # d=1 identity: fitted levels = previous actual + fitted difference
+    assert np.isfinite(fitted).all()
+    assert np.median(np.abs(fitted - y[1:])) < 1.0
+
+
+def test_kdf_filter_plot_and_options(spark, airbnb_pdf):
+    """The remaining ML 14 cells: options.plotting.backend, filter(items=),
+    and the kdf.plot.hist accessor (`ML 14:180-186`)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from sml_tpu import pandas_api as ks
+    ks.options.plotting.backend = "matplotlib"
+    assert ks.get_option("plotting.backend") == "matplotlib"
+    kdf = ks.DataFrame(spark.createDataFrame(airbnb_pdf))
+    graph_kdf = kdf.filter(items=["bedrooms", "price"])
+    assert sorted(graph_kdf.columns.tolist()) == ["bedrooms", "price"]
+    ax = graph_kdf.plot.hist(x="bedrooms", y="price", bins=20)
+    assert ax is not None
+    ax2 = kdf[["bedrooms", "price"]].plot.hist(bins=20)
+    assert ax2 is not None
+    ax3 = kdf["price"].plot.hist(bins=10)
+    assert ax3 is not None
