@@ -29,6 +29,11 @@ import os
 import sys
 import time
 
+# XLA:CPU AOT cache replays log a benign machine-feature banner (pseudo-
+# features like +prefer-no-scatter) at ERROR level per entry — silence the
+# C++ logs before jax loads so the bench output stays readable
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import numpy as np
 
 N_ROWS = 1_000_000

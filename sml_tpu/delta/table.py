@@ -99,7 +99,13 @@ def write_delta(df: DataFrame, path: str, mode: str = "errorifexists",
         prev_cols = [f["name"] for f in json.loads(prev["meta"].get("schemaString", "[]"))] \
             if prev["meta"].get("schemaString") else []
         if prev_cols and set(new_cols) != set(prev_cols):
-            if mode == "overwrite" and not overwrite_schema:
+            additive = set(prev_cols) <= set(new_cols)
+            if mode == "overwrite" and not overwrite_schema and \
+                    not (merge_schema and additive):
+                # Delta allows ADDITIVE evolution under mergeSchema for
+                # both append and overwrite (`ML 05L` overwrites with a
+                # new column under mergeSchema); destructive changes still
+                # need overwriteSchema
                 raise ValueError(
                     "A schema mismatch detected when writing to the Delta table. "
                     "To overwrite your schema, set option('overwriteSchema', 'true').")

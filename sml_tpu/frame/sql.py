@@ -190,10 +190,14 @@ def run_sql(session: "TpuSession", query: str):
 
 def _to_sqlite(pdf: pd.DataFrame, name: str, con) -> None:
     safe = pdf.copy()
+    primitives = (type(None), str, bytes, bool, int, float,
+                  np.integer, np.floating, np.bool_)
     for c in safe.columns:
-        if safe[c].dtype == object:
-            safe[c] = safe[c].map(
-                lambda v: str(v) if isinstance(v, (list, np.ndarray, dict)) else v)
+        kind = getattr(safe[c].dtype, "kind", "O")
+        if kind not in "ifubmM":  # objects, extension arrays (vectors), …
+            safe[c] = pd.Series(
+                [v if isinstance(v, primitives) else str(v)
+                 for v in safe[c]], index=safe.index, dtype=object)
     safe.to_sql(name, con, index=False, if_exists="replace")
 
 

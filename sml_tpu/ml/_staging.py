@@ -322,15 +322,20 @@ def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True,
     `work` is the caller's cost estimate; when given, the program is routed
     host/device by the measured-latency dispatcher (tiny reductions lose to
     a tunneled chip's fixed round-trip by orders of magnitude)."""
-    with routed_for(work, *arrays):
-        staged = stage_sharded(*arrays)
-        dev_args, mask, _ = staged[:-2], staged[-2], staged[-1]
-        n_lead = len(dev_args) + 1
-        rep_nums = tuple(range(n_lead, n_lead + len(replicated)))
-        compiled = cached_data_parallel(fn, out_replicated=out_replicated,
-                                        replicated_argnums=rep_nums)
-        out = compiled(*dev_args, mask, *replicated)
-        # ONE batched device→host transfer for the whole output tree: per-leaf
-        # np.asarray pays the tunnel's fixed D2H latency once PER ARRAY, which
-        # dominated r1's per-fit wall-clock on the real chip
-        return jax.device_get(out)
+    from ..utils.profiler import PROFILER
+    with routed_for(work, *arrays) as mesh:
+        route = "host" if dispatch.is_host_mesh(mesh) else "device"
+        with PROFILER.span(f"program.{getattr(fn, '__name__', 'fn')}",
+                           rows=int(np.shape(arrays[0])[0]) if arrays else 0,
+                           route=route):
+            staged = stage_sharded(*arrays)
+            dev_args, mask, _ = staged[:-2], staged[-2], staged[-1]
+            n_lead = len(dev_args) + 1
+            rep_nums = tuple(range(n_lead, n_lead + len(replicated)))
+            compiled = cached_data_parallel(fn, out_replicated=out_replicated,
+                                            replicated_argnums=rep_nums)
+            out = compiled(*dev_args, mask, *replicated)
+            # ONE batched device→host transfer for the whole output tree:
+            # per-leaf np.asarray pays the tunnel's fixed D2H latency once
+            # PER ARRAY, which dominated r1's per-fit wall-clock
+            return jax.device_get(out)

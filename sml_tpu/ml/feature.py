@@ -108,8 +108,10 @@ class VectorAssembler(Transformer):
             elif attrs is not None and "numFeatures" in attrs:
                 # previously-assembled vector column: attrs carry its width
                 width = int(attrs["numFeatures"])
-            else:
+            elif not getattr(df, "isStreaming", False):
                 # vector input columns occupy their own width; peek one row
+                # (streaming frames can't peek — their numeric inputs are
+                # width 1, which is the default)
                 if pdf0 is None:
                     pdf0 = df.limit(1).toPandas()
                 v = pdf0[c].iloc[0] if len(pdf0) else None
@@ -315,7 +317,13 @@ class OneHotEncoderModel(Model):
                 out[oc] = vector_series(block, index=out.index, sparse=True, na=na)
             return out
 
-        return df._derive(fn)
+        res = df._derive(fn)
+        # publish output widths as column metadata so VectorAssembler never
+        # needs a data peek for OHE inputs (streaming frames cannot peek)
+        for oc, size in zip(out_cols, sizes):
+            res._ml_attrs[oc] = {
+                "numFeatures": size - 1 if drop_last else size}
+        return res
 
     def _extra_metadata(self):
         return {"categorySizes": self.categorySizes}

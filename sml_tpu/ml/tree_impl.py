@@ -365,6 +365,18 @@ def _make_ensemble_program(es: EnsembleSpec):
 def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                            seed: int = 0):
     """Run the whole-ensemble program; returns (trees, base)."""
+    from ..parallel import dispatch as _dispatch
+    from ..parallel import mesh as _meshlib
+    from ..utils.profiler import PROFILER
+    with PROFILER.span(
+            "program.tree_ensemble", rows=int(binned_dev.shape[0]),
+            route="host" if _dispatch.is_host_mesh(_meshlib.get_mesh())
+            else "device", trees=es.n_trees):
+        return _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es, seed)
+
+
+def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
+                            seed: int = 0):
     from ..parallel import mesh as _meshlib
     key = (es, id(_meshlib.get_mesh()))  # programs are mesh-specific
     if key not in _ensemble_cache:

@@ -98,10 +98,22 @@ class StreamingDataFrame:
         self._schema = schema
         self._options = options
         self._ops = ops or []
+        self._ml_attrs: Dict[str, Any] = {}
 
     def _append(self, op: Callable[[DataFrame], DataFrame]) -> "StreamingDataFrame":
-        return StreamingDataFrame(self._session, self._path, self._fmt, self._schema,
-                                  self._options, self._ops + [op])
+        out = StreamingDataFrame(self._session, self._path, self._fmt, self._schema,
+                                 self._options, self._ops + [op])
+        out._ml_attrs = dict(getattr(self, "_ml_attrs", {}))
+        return out
+
+    # ML transformers drive frames through these two hooks; recording them
+    # lets `model.transform(stream_df)` and feature stages apply per
+    # micro-batch exactly like `MLE 00`'s streaming inference
+    def _derive(self, fn, schema=None) -> "StreamingDataFrame":
+        return self._append(lambda df: df._derive(fn, schema))
+
+    def _derive_rowlocal(self, fn, schema=None) -> "StreamingDataFrame":
+        return self._append(lambda df: df._derive_rowlocal(fn, schema))
 
     def __getattr__(self, item):
         if item.startswith("_") or item in ("writeStream",):

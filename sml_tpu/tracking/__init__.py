@@ -630,6 +630,11 @@ class _PysparkNamespace:
 pyspark = _PysparkNamespace()
 
 
+# `mlflow.tracking.MlflowClient` parity: the module aliases itself as its
+# own `tracking` submodule (`ML 04:196`, `ML 05` use both spellings)
+tracking = sys.modules[__name__]
+
+
 def install_mlflow_shim() -> None:
     """Alias this module as `mlflow` so course code imports run unchanged."""
     mod = sys.modules[__name__]

@@ -60,17 +60,26 @@ class Profiler:
             self._spans.clear()
 
     def report(self) -> str:
-        """Spark-UI-style aggregate table: op, calls, total s, rows."""
+        """Spark-UI-style aggregate table: op, calls, total s, rows, and
+        the dispatch route (host / device / mixed) where recorded."""
         agg: Dict[str, List[float]] = {}
         rows_agg: Dict[str, int] = {}
+        routes: Dict[str, set] = {}
         for s in self.spans():
             agg.setdefault(s.name, []).append(s.wall_s)
             if s.rows:
                 rows_agg[s.name] = rows_agg.get(s.name, 0) + s.rows
-        lines = [f"{'op':<32}{'calls':>8}{'total_s':>12}{'rows':>14}"]
+            r = s.meta.get("route")
+            if r:
+                routes.setdefault(s.name, set()).add(r)
+        lines = [f"{'op':<34}{'calls':>7}{'total_s':>11}{'rows':>13}{'route':>9}"]
         for name in sorted(agg, key=lambda n: -sum(agg[n])):
             ts = agg[name]
-            lines.append(f"{name:<32}{len(ts):>8}{sum(ts):>12.4f}{rows_agg.get(name, 0):>14}")
+            rset = routes.get(name, set())
+            route = (rset.pop() if len(rset) == 1
+                     else ("mixed" if rset else "-"))
+            lines.append(f"{name:<34}{len(ts):>7}{sum(ts):>11.4f}"
+                         f"{rows_agg.get(name, 0):>13}{route:>9}")
         return "\n".join(lines)
 
 

@@ -1,0 +1,142 @@
+"""Golden-metric regression gate (VERDICT r2 #4, SURVEY §4/§7 hard-part #1).
+
+GOLDEN.json pins the bench-shaped model metrics at n=100k/seed=42 on the
+CPU test mesh (f32 histograms — the TPU bench runs bf16 histogram operands
+and reports its own values in BENCH_r*.json). Any numerics change that
+moves a pinned metric fails CI; intentional changes regenerate with
+
+    python tests/test_golden_metrics.py --regen
+
+Also asserts the orderings the course states in prose: LR beats the
+mean-price baseline (`ML 02:155`), tuned RF at least matches a single
+tree (`ML 07:171`), XGBoost beats the plain forest (`ML 11`).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN_PATH = os.path.join(HERE, os.pardir, "GOLDEN.json")
+N_ROWS = 100_000
+
+
+def compute_metrics():
+    """The bench legs' fits at golden size; returns {metric: value}."""
+    import pandas as pd
+
+    from sml_tpu import functions as F
+    from sml_tpu.courseware import make_airbnb_dataset
+    from sml_tpu.frame.session import get_session
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import (Imputer, OneHotEncoder, StringIndexer,
+                                    VectorAssembler)
+    from sml_tpu.ml.regression import (DecisionTreeRegressor,
+                                       LinearRegression,
+                                       RandomForestRegressor)
+    from sml_tpu.xgboost import XgboostRegressor
+
+    CAT = ["neighbourhood_cleansed", "room_type", "property_type"]
+    NUM = ["accommodates", "bathrooms", "bedrooms", "beds",
+           "minimum_nights", "number_of_reviews", "review_scores_rating"]
+    spark = get_session()
+    df = spark.createDataFrame(make_airbnb_dataset(n=N_ROWS, seed=42))
+    train, test = df.randomSplit([0.8, 0.2], seed=42)
+    train.cache()
+    test.cache()
+    idx = [c + "_idx" for c in CAT]
+    ohe = [c + "_ohe" for c in CAT]
+    imp = [c + "_imp" for c in NUM]
+    prep = [Imputer(strategy="median", inputCols=NUM, outputCols=imp),
+            StringIndexer(inputCols=CAT, outputCols=idx,
+                          handleInvalid="skip")]
+    ev = RegressionEvaluator(labelCol="price")
+    out = {}
+
+    lr = Pipeline(stages=prep + [
+        OneHotEncoder(inputCols=idx, outputCols=ohe),
+        VectorAssembler(inputCols=ohe + imp, outputCol="features"),
+        LinearRegression(labelCol="price")]).fit(train)
+    out["rmse_lr"] = ev.evaluate(lr.transform(test))
+    mean_price = float(train.toPandas()["price"].mean())
+    out["rmse_mean_baseline"] = ev.evaluate(
+        lr.transform(test).withColumn("prediction", F.lit(mean_price)))
+
+    tree_feats = VectorAssembler(inputCols=idx + imp, outputCol="features")
+    dt = Pipeline(stages=prep + [tree_feats,
+                  DecisionTreeRegressor(labelCol="price", maxDepth=5,
+                                        maxBins=40)]).fit(train)
+    out["rmse_dt"] = ev.evaluate(dt.transform(test))
+
+    rf = Pipeline(stages=prep + [tree_feats,
+                  RandomForestRegressor(labelCol="price", maxDepth=6,
+                                        numTrees=20, maxBins=40,
+                                        seed=42)]).fit(train)
+    out["rmse_rf"] = ev.evaluate(rf.transform(test))
+
+    log_train = train.withColumn("label", F.log(F.col("price")))
+    log_test = test.withColumn("label", F.log(F.col("price")))
+    xgb = Pipeline(stages=prep + [tree_feats,
+                   XgboostRegressor(n_estimators=40, learning_rate=0.15,
+                                    max_depth=6, max_bins=64,
+                                    random_state=42)]).fit(log_train)
+    pred = xgb.transform(log_test).withColumn(
+        "prediction", F.exp(F.col("prediction")))
+    out["rmse_xgb"] = ev.evaluate(pred)
+    return {k: round(float(v), 6) for k, v in out.items()}
+
+
+@pytest.fixture(scope="module")
+def metrics():
+    return compute_metrics()
+
+
+def test_metrics_match_golden(metrics):
+    assert os.path.exists(GOLDEN_PATH), \
+        "GOLDEN.json missing; run: python tests/test_golden_metrics.py --regen"
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    assert golden["n_rows"] == N_ROWS and golden["seed"] == 42
+    for k, want in golden["metrics"].items():
+        got = metrics[k]
+        assert abs(got - want) < 1e-3, \
+            f"{k}: got {got}, golden {want} (Δ={abs(got - want):.2e})"
+
+
+def test_course_stated_orderings(metrics):
+    # ML 02:155 — the model must beat predicting the average price
+    assert metrics["rmse_lr"] < metrics["rmse_mean_baseline"]
+    # ML 07:171 — the (deeper, ensembled) forest beats the single tree
+    assert metrics["rmse_rf"] < metrics["rmse_dt"]
+    # ML 11 — boosted trees beat the forest on this data
+    assert metrics["rmse_xgb"] < metrics["rmse_rf"]
+    # everything is a real improvement over the constant baseline
+    for k in ("rmse_dt", "rmse_rf", "rmse_xgb"):
+        assert metrics[k] < metrics["rmse_mean_baseline"]
+
+
+def _regen():
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump({"n_rows": N_ROWS, "seed": 42,
+                   "environment": "virtual 8-device CPU mesh (f32 "
+                                  "histograms); the TPU bench uses bf16 "
+                                  "histogram operands and reports its own "
+                                  "metric values in BENCH_r*.json",
+                   "metrics": compute_metrics()}, f, indent=1)
+    print(f"wrote {os.path.abspath(GOLDEN_PATH)}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.join(HERE, os.pardir))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if "--regen" in sys.argv:
+        _regen()

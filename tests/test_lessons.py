@@ -1,0 +1,583 @@
+"""Notebook replay harness: each lesson's cell sequence end-to-end.
+
+The reference's own test strategy is "the notebooks are the integration
+tests" (`SML/Includes/Classroom-Setup.py:83-92`): a lesson passes when its
+cells run top to bottom and the printed metrics look right. This module
+replays every lesson ML 00b–ML 14 plus the electives as one
+assertion-bearing run each, using course-parity API names against the
+generated datasets (VERDICT r2 #5). Unit tests elsewhere cover the pieces;
+these prove each lesson COMPOSES.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sml_tpu import functions as F
+from sml_tpu.courseware import make_airbnb_dataset, make_movielens_dataset
+
+
+@pytest.fixture(scope="module")
+def raw_df(spark):
+    """The ML 01 entry point: price as a '$1,234.00'-style string, nulls in
+    the review/bath/bed columns — the raw sf-listings shape."""
+    pdf = make_airbnb_dataset(n=4000, seed=42)
+    rng = np.random.default_rng(0)
+    raw = pdf.copy()
+    raw["price"] = raw["price"].map(lambda v: f"${v:,.2f}")
+    raw.loc[rng.random(len(raw)) < 0.05, "bedrooms"] = np.nan
+    raw.loc[rng.random(len(raw)) < 0.05, "review_scores_rating"] = np.nan
+    return spark.createDataFrame(raw)
+
+
+@pytest.fixture(scope="module")
+def clean_dir(spark, raw_df, tmp_path_factory):
+    """ML 01's output: the cleansed Delta table every later lesson reads."""
+    out = str(tmp_path_factory.mktemp("lessons") / "airbnb-clean")
+    fixed_price_df = raw_df.withColumn(
+        "price", F.translate(F.col("price"), "$,", "").cast("double"))
+    pos_prices_df = fixed_price_df.filter(F.col("price") > 0)
+    min_nights_df = pos_prices_df.filter(F.col("minimum_nights") <= 365)
+    impute_cols = ["bedrooms", "review_scores_rating"]
+    doubles_df = min_nights_df
+    for c in impute_cols:
+        doubles_df = doubles_df.withColumn(
+            c + "_na", F.when(F.col(c).isNull(), 1.0).otherwise(0.0))
+    from sml_tpu.ml.feature import Imputer
+    imputer = Imputer(strategy="median", inputCols=impute_cols,
+                      outputCols=impute_cols)
+    imputed_df = imputer.fit(doubles_df).transform(doubles_df)
+    imputed_df.write.format("delta").mode("overwrite").save(out)
+    return out
+
+
+# ---------------------------------------------------------------- ML 00b / 00c
+def test_ml00b_spark_review(spark, raw_df):
+    """select / filter / groupBy / orderBy / cache / SQL view (`ML 00b`)."""
+    df = raw_df.select("room_type", "bedrooms", "price")
+    df.cache()
+    assert df.count() == 4000
+    counts = (df.groupBy("room_type").count()
+              .orderBy(F.col("count").desc()).toPandas())
+    assert counts["count"].iloc[0] == counts["count"].max()
+    df.createOrReplaceTempView("listings_view")
+    top = spark.sql(
+        "SELECT room_type, count(*) AS n FROM listings_view "
+        "GROUP BY room_type ORDER BY n DESC").toPandas()
+    assert sorted(top["n"].tolist(), reverse=True) == top["n"].tolist()
+
+
+def test_ml00c_delta_review(spark, tmp_path):
+    """Delta write → append → history → versionAsOf → vacuum guard."""
+    p = str(tmp_path / "delta-review")
+    df1 = spark.createDataFrame(pd.DataFrame({"id": [1, 2], "v": [1.0, 2.0]}))
+    df1.write.format("delta").mode("overwrite").save(p)
+    spark.createDataFrame(pd.DataFrame({"id": [3], "v": [3.0]})) \
+        .write.format("delta").mode("append").save(p)
+    from sml_tpu.delta.table import DeltaTable
+    hist = DeltaTable.forPath(spark, p).history().toPandas()
+    assert len(hist) == 2
+    v0 = spark.read.format("delta").option("versionAsOf", 0).load(p)
+    assert v0.count() == 2
+    assert spark.read.format("delta").load(p).count() == 3
+    with pytest.raises(Exception, match="retentionDurationCheck|retention"):
+        DeltaTable.forPath(spark, p).vacuum(0)
+
+
+# --------------------------------------------------------------------- ML 01
+def test_ml01_data_cleansing(spark, raw_df, clean_dir):
+    """The cleansing chain produced a numeric, imputed, flagged table."""
+    cleaned = spark.read.format("delta").load(clean_dir)
+    pdf = cleaned.toPandas()
+    assert pdf["price"].dtype == np.float64 and (pdf["price"] > 0).all()
+    assert "bedrooms_na" in pdf.columns
+    assert pdf["bedrooms"].notna().all()  # imputed in place
+    assert set(pdf["bedrooms_na"].unique()) <= {0.0, 1.0}
+    assert pdf["bedrooms_na"].sum() > 0  # the na flags recorded something
+
+
+# ---------------------------------------------------------------- ML 02 / 03
+def test_ml02_linear_regression_one_feature(spark, clean_dir):
+    """randomSplit(seed=42) → LR on bedrooms → beats the mean baseline
+    (`ML 02:155` states LR must beat predicting the average price)."""
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    vec = VectorAssembler(inputCols=["bedrooms"], outputCol="features")
+    lr = LinearRegression(featuresCol="features", labelCol="price")
+    lr_model = lr.fit(vec.transform(train_df))
+    preds = lr_model.transform(vec.transform(test_df))
+    ev = RegressionEvaluator(predictionCol="prediction", labelCol="price",
+                             metricName="rmse")
+    rmse = ev.evaluate(preds)
+    mean_price = train_df.toPandas()["price"].mean()
+    base = preds.withColumn("prediction", F.lit(float(mean_price)))
+    assert rmse < ev.evaluate(base)  # the course's stated ordering
+    assert lr_model.coefficients.toArray().shape == (1,)
+    assert np.isfinite(lr_model.intercept)
+
+
+def test_ml03_pipeline_save_load(spark, clean_dir, tmp_path):
+    """Full featurization pipeline, persisted and reloaded (`ML 03`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.base import PipelineModel
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import (OneHotEncoder, StringIndexer,
+                                    VectorAssembler)
+    from sml_tpu.ml.regression import LinearRegression
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    cat = ["neighbourhood_cleansed", "room_type"]
+    idx = [c + "Index" for c in cat]
+    ohe = [c + "OHE" for c in cat]
+    num = ["bedrooms", "accommodates", "minimum_nights"]
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCols=cat, outputCols=idx, handleInvalid="skip"),
+        OneHotEncoder(inputCols=idx, outputCols=ohe),
+        VectorAssembler(inputCols=ohe + num, outputCol="features"),
+        LinearRegression(labelCol="price")])
+    model = pipe.fit(train_df)
+    path = str(tmp_path / "lr-pipeline-model")
+    model.write().overwrite().save(path)
+    loaded = PipelineModel.load(path)
+    ev = RegressionEvaluator(labelCol="price")
+    r1 = ev.evaluate(model.transform(test_df))
+    r2 = ev.evaluate(loaded.transform(test_df))
+    assert abs(r1 - r2) < 1e-9
+    assert 0 < r1 < 200
+
+
+# ---------------------------------------------------------------- ML 04 / 05
+def test_ml04_mlflow_tracking(spark, clean_dir, tmp_path):
+    """start_run → log_param/metric/model → search_runs (`ML 04`)."""
+    from sml_tpu import tracking as mlflow
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    mlflow.set_experiment("ml04")
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, _ = df.randomSplit([.8, .2], seed=42)
+    fdf = VectorAssembler(inputCols=["bedrooms"],
+                          outputCol="features").transform(train_df)
+    with mlflow.start_run(run_name="lr-single") as run:
+        model = LinearRegression(labelCol="price").fit(fdf)
+        mlflow.log_param("label", "price")
+        mlflow.log_metric("rmse", float(model.summary.rootMeanSquaredError))
+        mlflow.spark.log_model(model, "model")
+    runs = mlflow.search_runs()
+    assert len(runs) >= 1
+    got = mlflow.get_run(run.info.run_id)
+    assert got.data.params["label"] == "price"
+    assert got.data.metrics["rmse"] > 0
+
+
+def test_ml05_model_registry(spark, clean_dir, tmp_path):
+    """Register → stage transition → load-by-stage → predict (`ML 05`)."""
+    from sml_tpu import tracking as mlflow
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    mlflow.set_experiment("ml05")
+    df = spark.read.format("delta").load(clean_dir)
+    fdf = VectorAssembler(inputCols=["bedrooms"],
+                          outputCol="features").transform(df)
+    with mlflow.start_run() as run:
+        model = LinearRegression(labelCol="price").fit(fdf)
+        mlflow.spark.log_model(model, "model")
+    name = "ml05_lr"
+    mv = mlflow.register_model(f"runs:/{run.info.run_id}/model", name)
+    client = mlflow.tracking.MlflowClient()
+    client.transition_model_version_stage(name, mv.version,
+                                          stage="Production")
+    loaded = mlflow.spark.load_model(f"models:/{name}/Production")
+    out = loaded.transform(fdf).toPandas()
+    assert "prediction" in out.columns and np.isfinite(out["prediction"]).all()
+
+
+def test_ml05L_registry_with_delta_time_travel(spark, clean_dir, tmp_path):
+    """The lab's flow: model v1 on delta v0 → mergeSchema adds a column →
+    model v2 → versionAsOf reproduces v1's training data (`ML 05L`)."""
+    from sml_tpu import tracking as mlflow
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    mlflow.set_experiment("ml05L")
+    p = str(tmp_path / "delta-lab")
+    df = spark.read.format("delta").load(clean_dir)
+    df.select("bedrooms", "accommodates", "price") \
+        .write.format("delta").mode("overwrite").save(p)
+
+    def fit_on(frame, cols):
+        fdf = VectorAssembler(inputCols=cols,
+                              outputCol="features").transform(frame)
+        return LinearRegression(labelCol="price").fit(fdf)
+
+    name = "ml05L_lr"
+    with mlflow.start_run() as r1:
+        m1 = fit_on(spark.read.format("delta").load(p), ["bedrooms"])
+        mlflow.spark.log_model(m1, "model")
+    mlflow.register_model(f"runs:/{r1.info.run_id}/model", name)
+
+    # schema evolution: add a column with mergeSchema, retrain, re-register
+    df.select("bedrooms", "accommodates", "price") \
+        .withColumn("log_price", F.log(F.col("price"))) \
+        .write.format("delta").mode("overwrite") \
+        .option("mergeSchema", "true").save(p)
+    with mlflow.start_run() as r2:
+        m2 = fit_on(spark.read.format("delta").load(p),
+                    ["bedrooms", "accommodates"])
+        mlflow.spark.log_model(m2, "model")
+    mv2 = mlflow.register_model(f"runs:/{r2.info.run_id}/model", name)
+    assert int(mv2.version) == 2
+    # time travel reproduces the v1 training frame (no log_price column)
+    v0 = spark.read.format("delta").option("versionAsOf", 0).load(p)
+    assert "log_price" not in v0.columns
+    assert "log_price" in spark.read.format("delta").load(p).columns
+
+
+# ---------------------------------------------------------------- ML 06 / 07
+def test_ml06_decision_tree(spark, clean_dir):
+    """maxBins failure on high-cardinality categoricals, the fix, and
+    featureImportances (`ML 06:91-154`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    from sml_tpu.ml.regression import DecisionTreeRegressor
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    cat = ["neighbourhood_cleansed", "room_type", "property_type"]
+    idx = [c + "Index" for c in cat]
+    stages = [StringIndexer(inputCols=cat, outputCols=idx,
+                            handleInvalid="skip"),
+              VectorAssembler(inputCols=idx + ["bedrooms", "accommodates"],
+                              outputCol="features")]
+    dt_small = DecisionTreeRegressor(labelCol="price", maxBins=2)
+    with pytest.raises(Exception, match="maxBins"):
+        Pipeline(stages=stages + [dt_small]).fit(train_df)
+    dt = DecisionTreeRegressor(labelCol="price", maxBins=40)
+    model = Pipeline(stages=stages + [dt]).fit(train_df)
+    imp = model.stages[-1].featureImportances.toArray()
+    assert imp.shape == (5,) and abs(imp.sum() - 1.0) < 1e-6
+    out = model.transform(test_df).toPandas()
+    assert np.isfinite(out["prediction"]).all()
+
+
+def test_ml07_random_forest_cv(spark, clean_dir):
+    """RF grid CV with parallelism, best model beats a single tree
+    (`ML 07:102-171`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    from sml_tpu.ml.regression import (DecisionTreeRegressor,
+                                       RandomForestRegressor)
+    from sml_tpu.ml.tuning import CrossValidator, ParamGridBuilder
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    stages = [StringIndexer(inputCols=["room_type"],
+                            outputCols=["room_typeIndex"],
+                            handleInvalid="skip"),
+              VectorAssembler(
+                  inputCols=["room_typeIndex", "bedrooms", "accommodates",
+                             "number_of_reviews"], outputCol="features")]
+    feat_train = Pipeline(stages=stages).fit(train_df).transform(train_df)
+    feat_test = Pipeline(stages=stages).fit(train_df).transform(test_df)
+    rf = RandomForestRegressor(labelCol="price", seed=42)
+    grid = (ParamGridBuilder()
+            .addGrid(rf.getParam("maxDepth"), [2, 5])
+            .addGrid(rf.getParam("numTrees"), [5, 10]).build())
+    ev = RegressionEvaluator(labelCol="price")
+    cv = CrossValidator(estimator=rf, estimatorParamMaps=grid, evaluator=ev,
+                        numFolds=3, parallelism=4, seed=42)
+    cv_model = cv.fit(feat_train)
+    assert len(cv_model.avgMetrics) == 4
+    rmse_rf = ev.evaluate(cv_model.bestModel.transform(feat_test))
+    dt = DecisionTreeRegressor(labelCol="price", maxDepth=2, maxBins=40)
+    rmse_dt = ev.evaluate(dt.fit(feat_train).transform(feat_test))
+    assert rmse_rf <= rmse_dt * 1.05  # RF (tuned) at least matches a stump
+
+
+# -------------------------------------------------------------------- ML 08
+def test_ml08_hyperopt(spark, clean_dir):
+    """fmin/tpe/hp search over RF params, course budget (`ML 08:146`)."""
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import RandomForestRegressor
+    from sml_tpu.tune import STATUS_OK, Trials, fmin, hp, tpe
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, _ = df.randomSplit([.8, .2], seed=42)
+    fdf = VectorAssembler(inputCols=["bedrooms", "accommodates"],
+                          outputCol="features").transform(train_df)
+    ev = RegressionEvaluator(labelCol="price")
+
+    def objective(params):
+        m = RandomForestRegressor(labelCol="price", seed=42,
+                                  maxDepth=int(params["max_depth"]),
+                                  numTrees=int(params["num_trees"])).fit(fdf)
+        return {"loss": ev.evaluate(m.transform(fdf)), "status": STATUS_OK}
+
+    space = {"max_depth": hp.quniform("max_depth", 2, 5, 1),
+             "num_trees": hp.quniform("num_trees", 5, 10, 5)}
+    trials = Trials()
+    best = fmin(objective, space, algo=tpe, max_evals=4, trials=trials,
+                rstate=np.random.RandomState(42))
+    assert {"max_depth", "num_trees"} <= set(best)
+    assert len(trials.trials) == 4
+
+
+# ---------------------------------------------------------------- ML 09 / 10
+def test_ml09_automl(spark, clean_dir):
+    from sml_tpu import automl
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, _ = df.randomSplit([.8, .2], seed=42)
+    summary = automl.regress(train_df.select("bedrooms", "accommodates",
+                                             "price"),
+                             target_col="price", timeout_minutes=1,
+                             max_trials=3)
+    assert summary.best_trial is not None
+    assert np.isfinite(summary.best_trial.metrics["val_rmse"])
+
+
+def test_ml10_feature_store(spark, clean_dir, tmp_path):
+    from sml_tpu import tracking as mlflow
+    from sml_tpu.feature_store import FeatureLookup, FeatureStoreClient
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    os.environ["SML_FEATURE_STORE_DIR"] = str(tmp_path / "fs")
+    fs = FeatureStoreClient()
+    df = spark.read.format("delta").load(clean_dir)
+    pdf = df.toPandas().reset_index().rename(columns={"index": "listing_id"})
+    feats = spark.createDataFrame(
+        pdf[["listing_id", "bedrooms", "accommodates"]])
+    fs.create_table(name="lessons_fs.features", primary_keys=["listing_id"],
+                    df=feats, description="airbnb features")
+    labels = spark.createDataFrame(pdf[["listing_id", "price"]])
+    training_set = fs.create_training_set(
+        labels, [FeatureLookup(table_name="lessons_fs.features",
+                               lookup_key="listing_id")],
+        label="price")
+    tdf = training_set.load_df()
+    from sml_tpu.ml import Pipeline
+    with mlflow.start_run() as run:
+        # log the WHOLE pipeline so score_batch can go raw columns → pred
+        model = Pipeline(stages=[
+            VectorAssembler(inputCols=["bedrooms", "accommodates"],
+                            outputCol="features"),
+            LinearRegression(labelCol="price")]).fit(tdf)
+        fs.log_model(model, "model", training_set=training_set,
+                     registered_model_name="lessons_fs_model")
+    scored = fs.score_batch(f"runs:/{run.info.run_id}/model", labels)
+    out = scored.toPandas()
+    assert "prediction" in out.columns and len(out) == len(pdf)
+
+
+# -------------------------------------------------------------------- ML 11
+def test_ml11_xgboost(spark, clean_dir):
+    """Log-price boosted trees beat the linear model (`ML 11`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    from sml_tpu.xgboost import XgboostRegressor
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    log_train = train_df.withColumn("label", F.log(F.col("price")))
+    log_test = test_df.withColumn("label", F.log(F.col("price")))
+    stages = [StringIndexer(inputCols=["room_type"],
+                            outputCols=["room_typeIndex"],
+                            handleInvalid="skip"),
+              VectorAssembler(inputCols=["room_typeIndex", "bedrooms",
+                                         "accommodates"],
+                              outputCol="features")]
+    xgb = XgboostRegressor(n_estimators=20, max_depth=4, learning_rate=0.2,
+                           random_state=42)
+    model = Pipeline(stages=stages + [xgb]).fit(log_train)
+    preds = model.transform(log_test).withColumn(
+        "prediction", F.exp(F.col("prediction")))
+    rmse = RegressionEvaluator(labelCol="price").evaluate(preds)
+    assert 0 < rmse < 200
+
+
+# ---------------------------------------------------------------- ML 12 / 13
+def test_ml12_pandas_udf_inference(spark, clean_dir, tmp_path):
+    """Load-once scoring through mapInPandas and the pyfunc spark_udf
+    (`ML 12:101-143`)."""
+    from sml_tpu import tracking as mlflow
+    from sml_tpu.ml import DeviceScorer, Pipeline
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    from sml_tpu.ml.regression import RandomForestRegressor
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    model = Pipeline(stages=[
+        StringIndexer(inputCols=["room_type"], outputCols=["room_typeIndex"],
+                      handleInvalid="skip"),
+        VectorAssembler(inputCols=["room_typeIndex", "bedrooms",
+                                   "accommodates"], outputCol="features"),
+        RandomForestRegressor(labelCol="price", numTrees=5, maxDepth=4,
+                              seed=42)]).fit(train_df)
+    scorer = DeviceScorer(model)
+
+    def predict(iterator):
+        for features in iterator:
+            yield pd.DataFrame({"prediction": scorer(features)})
+
+    preds = test_df.mapInPandas(predict, "prediction double")
+    n = preds.count()
+    assert n == test_df.count()
+    # pyfunc-style whole-frame UDF via the tracking module
+    with mlflow.start_run() as run:
+        mlflow.spark.log_model(model, "model")
+    udf_model = mlflow.pyfunc.spark_udf(spark,
+                                        f"runs:/{run.info.run_id}/model")
+    out = test_df.withColumn("prediction",
+                             udf_model(*test_df.columns)).toPandas()
+    assert np.isfinite(out["prediction"]).all()
+
+
+def test_ml13_pandas_function_api(spark, clean_dir, tmp_path):
+    """Per-group model training through applyInPandas (`ML 13:119-161`)."""
+    from sml_tpu import tracking as mlflow
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    mlflow.set_experiment("ml13")
+    df = spark.read.format("delta").load(clean_dir)
+
+    def train_model(pdf):
+        from sklearn.linear_model import LinearRegression as SkLR
+        g = pdf.dropna(subset=["bedrooms", "accommodates", "price"])
+        m = SkLR().fit(g[["bedrooms", "accommodates"]], g["price"])
+        mse = float(np.mean(
+            (m.predict(g[["bedrooms", "accommodates"]]) - g["price"]) ** 2))
+        return pd.DataFrame({"room_type": [g["room_type"].iloc[0]],
+                             "n_used": [len(g)], "mse": [mse]})
+
+    out = df.groupby("room_type").applyInPandas(
+        train_model, "room_type string, n_used bigint, mse double").toPandas()
+    assert len(out) == df.toPandas()["room_type"].nunique()
+    assert np.isfinite(out["mse"]).all()
+
+
+# -------------------------------------------------------------------- ML 14
+def test_ml14_koalas(spark, clean_dir):
+    import matplotlib
+    matplotlib.use("Agg")
+    from sml_tpu import pandas_api as ks
+    df = spark.read.format("delta").load(clean_dir)
+    kdf = ks.DataFrame(df)
+    vc = kdf["room_type"].value_counts()
+    assert vc.sum() == df.count()
+    ks.options.plotting.backend = "matplotlib"
+    assert kdf.filter(items=["bedrooms", "price"]) \
+        .plot.hist(x="bedrooms", y="price", bins=20) is not None
+    distinct = ks.sql("select distinct(room_type) from {kdf}")
+    assert len(distinct.to_pandas()) == df.toPandas()["room_type"].nunique()
+
+
+# ------------------------------------------------------------------ electives
+def test_mle00_streaming_inference(spark, clean_dir, tmp_path):
+    """Micro-batch scoring of a file stream (`MLE 00`)."""
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    df = spark.read.format("delta").load(clean_dir)
+    vec = VectorAssembler(inputCols=["bedrooms", "accommodates"],
+                          outputCol="features")
+    model = LinearRegression(labelCol="price").fit(vec.transform(df))
+    src = tmp_path / "stream-src"
+    src.mkdir()
+    pdf = df.toPandas()
+    for i in range(3):
+        pdf.iloc[i * 100:(i + 1) * 100].to_parquet(src / f"part-{i}.parquet")
+    stream = (spark.readStream.format("parquet")
+              .option("maxFilesPerTrigger", 1)
+              .schema(df.schema).load(str(src)))
+    scored = model.transform(vec.transform(stream))
+    q = (scored.writeStream.format("memory").queryName("mle00_preds")
+         .option("checkpointLocation", str(tmp_path / "ckpt"))
+         .trigger(processingTime="0 seconds").start())
+    q.processAllAvailable()
+    out = spark.sql("SELECT count(*) AS n FROM mle00_preds").toPandas()
+    q.stop()
+    assert int(out["n"].iloc[0]) == 300
+
+
+def test_mle01_als_collaborative_filtering(spark):
+    """ALS on MovieLens-shaped ratings + RMSE evaluation (`MLE 01`)."""
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.recommendation import ALS
+    ratings = make_movielens_dataset(n_users=300, n_items=120,
+                                     n_ratings=8000, seed=42)
+    df = spark.createDataFrame(ratings)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    als = ALS(userCol="userId", itemCol="movieId", ratingCol="rating",
+              rank=8, maxIter=5, regParam=0.1, seed=42,
+              coldStartStrategy="drop")
+    model = als.fit(train_df)
+    preds = model.transform(test_df)
+    rmse = RegressionEvaluator(labelCol="rating").evaluate(preds)
+    assert 0.5 < rmse < 2.0  # sane for 1-5 star synthetic ratings
+    recs = model.recommendForAllUsers(3).toPandas()
+    assert len(recs) > 0
+
+
+def test_mle02_kmeans(spark):
+    """KMeans on the iris-like flow with cluster quality (`MLE 02`)."""
+    from sklearn.datasets import make_blobs
+    from sml_tpu.ml.clustering import KMeans
+    from sml_tpu.ml.evaluation import ClusteringEvaluator
+    from sml_tpu.ml.feature import VectorAssembler
+    X, _ = make_blobs(n_samples=600, centers=3, cluster_std=1.0,
+                      random_state=42)
+    pdf = pd.DataFrame(X, columns=["f0", "f1"])
+    df = spark.createDataFrame(pdf)
+    fdf = VectorAssembler(inputCols=["f0", "f1"],
+                          outputCol="features").transform(df)
+    model = KMeans(k=3, seed=42, maxIter=20).fit(fdf)
+    preds = model.transform(fdf)
+    sil = ClusteringEvaluator().evaluate(preds)
+    assert sil > 0.5  # well-separated blobs
+    assert len(model.clusterCenters()) == 3
+
+
+def test_mle03_logistic_regression(spark, clean_dir):
+    """Binary classification with AUROC (`MLE 03`)."""
+    from sml_tpu.ml.classification import LogisticRegression
+    from sml_tpu.ml.evaluation import BinaryClassificationEvaluator
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    df = spark.read.format("delta").load(clean_dir)
+    df = df.withColumn("label",
+                       F.when(F.col("price") >= 150, 1.0).otherwise(0.0))
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    stages_df = StringIndexer(inputCols=["room_type"],
+                              outputCols=["room_typeIndex"],
+                              handleInvalid="skip")
+    tr = stages_df.fit(train_df).transform(train_df)
+    te = stages_df.fit(train_df).transform(test_df)
+    vec = VectorAssembler(inputCols=["room_typeIndex", "bedrooms",
+                                     "accommodates"], outputCol="features")
+    model = LogisticRegression(labelCol="label").fit(vec.transform(tr))
+    preds = model.transform(vec.transform(te))
+    auc = BinaryClassificationEvaluator(labelCol="label").evaluate(preds)
+    assert auc > 0.6
+
+
+def test_mle04_time_series(spark):
+    """ADF test → ARIMA(1,2,1) → Prophet-style forecast (`MLE 04`)."""
+    from sml_tpu.timeseries import ARIMA, Prophet, adfuller
+    t = np.arange(160, dtype=float)
+    rng = np.random.default_rng(42)
+    y = 0.02 * t * t + 1.5 * t + 20 + rng.normal(scale=1.0, size=len(t))
+    stat, pvalue = adfuller(y)[:2]
+    assert pvalue > 0.05  # trending series: non-stationary, as taught
+    res = ARIMA(y, order=(1, 2, 1)).fit()
+    assert np.isfinite(res.aic)
+    fc = res.forecast(10)
+    assert np.isfinite(fc).all() and fc[-1] > y[-1]
+    ds = pd.date_range("2020-01-01", periods=len(t), freq="D")
+    m = Prophet()
+    m.fit(pd.DataFrame({"ds": ds, "y": y}))
+    future = m.make_future_dataframe(periods=10)
+    fcst = m.predict(future)
+    assert {"ds", "yhat"} <= set(fcst.columns)
+    assert len(fcst) == len(t) + 10
