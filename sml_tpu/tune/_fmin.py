@@ -96,23 +96,31 @@ SparkTrials = TpuTrials  # drop-in name for course code
 
 
 # ---------------------------------------------------------------------------
+def _bw(obs: np.ndarray) -> float:
+    """Unit-space KDE bandwidth, shared by the proposal sampler and the
+    scoring density (one constant, one formula — they must stay in sync).
+    The 0.1 floor keeps exploration alive once the good set clusters."""
+    return max(float(np.std(obs)) * max(len(obs), 1) ** -0.2, 0.1)
+
+
 def _kde_logpdf(x: np.ndarray, obs: np.ndarray) -> np.ndarray:
     """1-D Gaussian-KDE log-density in unit space, mixed with a uniform
     prior (weight 0.2) the way TPE keeps its prior component alive."""
     if len(obs) == 0:
         return np.zeros_like(x)
-    bw = max(np.std(obs) * len(obs) ** -0.2, 0.04)
+    bw = _bw(obs)
     d = (x[:, None] - obs[None, :]) / bw
     kde = np.mean(np.exp(-0.5 * d * d), axis=1) / (bw * np.sqrt(2 * np.pi))
     return np.log(0.9 * kde + 0.1 + 1e-300)
 
 
 def _tpe_propose(space: Dict[str, Dimension], completed, rng: np.random.RandomState,
-                 gamma: float = 0.5, n_candidates: int = 64) -> Dict[str, Any]:
+                 gamma: float = 0.25, n_candidates: int = 64) -> Dict[str, Any]:
     losses = np.array([l for _, l in completed])
-    # good set = best ceil(γ·√n) trials (hyperopt's sqrt schedule: selective
-    # early, slowly growing), everything else is the background density
-    n_good = max(2, int(np.ceil(gamma * np.sqrt(len(losses)))))
+    # good set = best γ-quantile, capped at 25 (hyperopt's linear schedule;
+    # an r2-era √n schedule kept the set at ~3 clustered points, collapsing
+    # the KDE bandwidth to its floor and freezing the search on plateaus)
+    n_good = min(25, max(3, int(np.ceil(gamma * len(losses)))))
     cut = np.sort(losses)[n_good - 1]
     good = [p for p, l in completed if l <= cut][:n_good]
     bad = [p for p, l in completed if l > cut]
@@ -127,9 +135,11 @@ def _tpe_propose(space: Dict[str, Dimension], completed, rng: np.random.RandomSt
             for p in bad:
                 cb[int(p[name])] += 1
             score = np.log(cg / cg.sum()) - np.log(cb / cb.sum())
-            probs = cg / cg.sum()
-            cands = rng.choice(k, size=n_candidates, p=probs)
-            out[name] = int(cands[np.argmax(score[cands])])
+            # sample ∝ good-probability · exp(score), mirroring the
+            # continuous branch: a deterministic argmax freezes categorical
+            # dims on plateaus exactly like it froze continuous ones
+            w = (cg / cg.sum()) * np.exp(score - score.max())
+            out[name] = int(rng.choice(k, p=w / w.sum()))
         else:
             g = np.array([dim.to_unit(p[name]) for p in good])
             b = np.array([dim.to_unit(p[name]) for p in bad])
@@ -137,14 +147,20 @@ def _tpe_propose(space: Dict[str, Dimension], completed, rng: np.random.RandomSt
             # bandwidth), 1/4 uniform exploration — the prior mixture that
             # keeps TPE from collapsing onto an early local mode
             n_exploit = (3 * n_candidates) // 4 if len(g) else 0
-            bw = max(np.std(g) * max(len(g), 1) ** -0.2, 0.04) if len(g) else 1.0
+            bw = _bw(g) if len(g) else 1.0
             exploit = np.clip(g[rng.randint(0, max(len(g), 1), n_exploit)]
                               + rng.normal(0, bw, n_exploit), 0, 1) \
                 if n_exploit else np.zeros(0)
             explore = rng.uniform(0, 1, n_candidates - n_exploit)
             cands = np.concatenate([exploit, explore])
             score = _kde_logpdf(cands, g) - _kde_logpdf(cands, b)
-            out[name] = dim.from_unit(float(cands[np.argmax(score)]))
+            # SAMPLE ∝ exp(score) instead of argmax: a deterministic argmax
+            # re-proposes the good-set mode forever (nothing new ever enters
+            # the good set — the r2 search could stall on plateaus and lose
+            # to random); the softmax draw is the exploration TPE needs
+            w = np.exp(score - score.max())
+            out[name] = dim.from_unit(
+                float(cands[rng.choice(len(cands), p=w / w.sum())]))
     return out
 
 
