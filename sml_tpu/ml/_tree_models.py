@@ -154,6 +154,7 @@ import threading as _threading
 _bins_cache: dict = {}
 _bins_cache_order: list = []
 _bins_cache_bytes: list = [0]
+_bins_inflight: dict = {}  # key -> Event set when that key's bins land
 _bins_lock = _threading.Lock()  # parallel tuning trials bin concurrently
 _BINS_CACHE_MAX_BYTES = 1 << 30
 
@@ -169,21 +170,35 @@ def _cached_bins(X, y32, max_bins, categorical):
     Xc = _normalize(X)
     key = (_content_key(Xc), _content_key(_normalize(y32)), int(max_bins),
            tuple(sorted((categorical or {}).items())))
-    with _bins_lock:
-        hit = _bins_cache.get(key)
-    if hit is None:
+    while True:
+        with _bins_lock:
+            hit = _bins_cache.get(key)
+            if hit is None and key not in _bins_inflight:
+                _bins_inflight[key] = _threading.Event()
+                break  # this thread computes
+            waiter = _bins_inflight.get(key) if hit is None else None
+        if hit is not None:
+            return hit
+        # another tuning trial is quantizing the SAME matrix: wait for it
+        # instead of paying the ~0.3s re-binning the cache exists to avoid
+        waiter.wait()
+    try:
         hit = make_bins(Xc, y32, max_bins, categorical)
         cost = hit[0].nbytes
         with _bins_lock:
-            if key not in _bins_cache:
-                _bins_cache[key] = hit
-                _bins_cache_order.append((key, cost))
-                _bins_cache_bytes[0] += cost
-                while _bins_cache_bytes[0] > _BINS_CACHE_MAX_BYTES \
-                        and len(_bins_cache_order) > 1:
-                    old, old_cost = _bins_cache_order.pop(0)
-                    _bins_cache.pop(old, None)
-                    _bins_cache_bytes[0] -= old_cost
+            _bins_cache[key] = hit
+            _bins_cache_order.append((key, cost))
+            _bins_cache_bytes[0] += cost
+            while _bins_cache_bytes[0] > _BINS_CACHE_MAX_BYTES \
+                    and len(_bins_cache_order) > 1:
+                old, old_cost = _bins_cache_order.pop(0)
+                _bins_cache.pop(old, None)
+                _bins_cache_bytes[0] -= old_cost
+    finally:
+        with _bins_lock:
+            ev = _bins_inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
     return hit
 
 

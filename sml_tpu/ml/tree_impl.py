@@ -82,8 +82,8 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
                 f"Consider removing this and other categorical features with "
                 f"a large number of values, or add more training examples.")
     edges = np.full((F, max_bins - 1), np.inf, dtype=np.float32)
-    binned = np.zeros((n, F), dtype=np.int32)
     remaps: Dict[int, np.ndarray] = {}
+    edge_list: list = [np.zeros(0, dtype=np.float32)] * F
     for f in range(F):
         col = X[:, f]
         if f in categorical:
@@ -99,7 +99,6 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
             rank = np.empty(card, dtype=np.int32)
             rank[order] = np.arange(card, dtype=np.int32)
             remaps[f] = rank
-            binned[:, f] = rank[ids]
             edges[f, :] = np.inf  # traversal uses bins directly
         else:
             finite = col[np.isfinite(col)]
@@ -115,26 +114,41 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
             qs = np.quantile(finite, np.linspace(0, 1, max_bins + 1)[1:-1])
             qs = np.unique(qs.astype(np.float32))
             edges[f, :len(qs)] = qs
-            binned[:, f] = np.searchsorted(qs, col, side="left").astype(np.int32)
-            binned[~np.isfinite(col), f] = 0  # missing → lowest bin
+            edge_list[f] = qs
+    binned = _bin_columns(X, edge_list, remaps)
     return binned, Binning(edges=edges, cat_remap=remaps)
+
+
+def _bin_columns(X: np.ndarray, edge_list, remaps: Dict[int, np.ndarray]) -> np.ndarray:
+    """Full-column discretization against known edges/remaps: the threaded
+    C++ kernel (`native/binning.cc`) when available, NumPy otherwise —
+    identical semantics (searchsorted 'left'; non-finite → bin 0)."""
+    from ..native import binning as _native_binning
+    n, F = X.shape
+    binned = _native_binning.bin_continuous(X, edge_list, remaps)
+    if binned is None:
+        binned = np.zeros((n, F), dtype=np.int32)
+        for f in range(F):
+            if f in remaps:
+                continue
+            qs = edge_list[f]
+            if len(qs) == 0:
+                continue
+            col = X[:, f]
+            binned[:, f] = np.searchsorted(qs, col,
+                                           side="left").astype(np.int32)
+            binned[~np.isfinite(col), f] = 0  # missing → lowest bin
+    for f, rank in remaps.items():
+        ids = np.clip(X[:, f].astype(np.int64), 0, len(rank) - 1)
+        binned[:, f] = rank[ids]
+    return binned
 
 
 def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
     """Apply training-time bin edges / category ranks at predict time."""
-    n, F = X.shape
-    out = np.zeros((n, F), dtype=np.int32)
-    for f in range(F):
-        if f in binning.cat_remap:
-            rank = binning.cat_remap[f]
-            ids = np.clip(X[:, f].astype(np.int64), 0, len(rank) - 1)
-            out[:, f] = rank[ids]
-        else:
-            e = binning.edges[f]
-            e = e[np.isfinite(e)]
-            out[:, f] = np.searchsorted(e, X[:, f], side="left").astype(np.int32)
-            out[~np.isfinite(X[:, f]), f] = 0
-    return out
+    edge_list = [binning.edges[f][np.isfinite(binning.edges[f])]
+                 for f in range(X.shape[1])]
+    return _bin_columns(X, edge_list, binning.cat_remap)
 
 
 # ---------------------------------------------------------------------------

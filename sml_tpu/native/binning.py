@@ -1,0 +1,78 @@
+"""ctypes wrapper for the C++ binning kernel, with NumPy parity fallback.
+
+`bin_continuous(X, edges_list, categorical)` returns the (n, F) int32 bin
+matrix for the CONTINUOUS features (categorical slots are left 0 for the
+caller's remap pass) — semantics identical to the NumPy expression
+
+    np.searchsorted(edges_f, X[:, f], side="left")  # then non-finite → 0
+
+used by `ml.tree_impl.make_bins` / `bin_with`; a parity test pins the two
+implementations against each other.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .build import load_library
+
+_sig_ready = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _sig_ready
+    lib = load_library("binning")
+    if lib is not None and not _sig_ready:
+        tail = [ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32)]
+        lib.bin_matrix.argtypes = [ctypes.POINTER(ctypes.c_double)] + tail
+        lib.bin_matrix.restype = None
+        lib.bin_matrix_f32.argtypes = [ctypes.POINTER(ctypes.c_float)] + tail
+        lib.bin_matrix_f32.restype = None
+        _sig_ready = True
+    return lib
+
+
+def bin_continuous(X: np.ndarray, edges_list: List[np.ndarray],
+                   categorical: Dict[int, int]) -> Optional[np.ndarray]:
+    """(n, F) int32 bins for continuous slots via the native kernel, or
+    None when the kernel is unavailable (caller uses the NumPy path)."""
+    n, F = X.shape
+    lib = _lib()
+    if lib is None or n == 0 or F == 0:
+        return None
+    # keep the input dtype: an f32 block (the fused feature path's layout)
+    # binned through an f64 copy would double peak memory at 1M+ rows
+    if X.dtype == np.float32:
+        Xc = np.ascontiguousarray(X)
+        fn, ptr_t = lib.bin_matrix_f32, ctypes.c_float
+    else:
+        Xc = np.ascontiguousarray(X, dtype=np.float64)
+        fn, ptr_t = lib.bin_matrix, ctypes.c_double
+    max_edges = max((len(e) for e in edges_list), default=0)
+    if max_edges == 0:
+        return np.zeros((n, F), dtype=np.int32)
+    edges = np.zeros((F, max_edges), dtype=np.float32)
+    n_edges = np.zeros(F, dtype=np.int32)
+    for f, e in enumerate(edges_list):
+        edges[f, :len(e)] = e
+        n_edges[f] = len(e)
+    is_cat = np.zeros(F, dtype=np.uint8)
+    for f in categorical:
+        if 0 <= int(f) < F:
+            is_cat[int(f)] = 1
+    out = np.zeros((n, F), dtype=np.int32)
+    fn(
+        Xc.ctypes.data_as(ctypes.POINTER(ptr_t)),
+        ctypes.c_int64(n), ctypes.c_int32(F),
+        edges.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_edges.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(max_edges),
+        is_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out

@@ -57,3 +57,4 @@ def test_partition_ids_nonnegative():
     h = np.array([-5, -1, 0, 7, 123456], dtype=np.int32)
     ids = hashing.hash_partition_ids(h, 8)
     assert ((ids >= 0) & (ids < 8)).all()
+
