@@ -53,5 +53,13 @@ from .conf import GLOBAL_CONF
 from .frame import DataFrame, Row, TpuSession, functions, get_session
 from .version import __version__
 
+
+def install_shims() -> None:
+    """Register the pyspark/mlflow/hyperopt/databricks import shims so
+    reference course code runs unchanged (see sml_tpu/compat.py)."""
+    from .compat import install_shims as _install
+    _install()
+
+
 __all__ = ["TpuSession", "DataFrame", "Row", "functions", "get_session",
-           "GLOBAL_CONF", "__version__"]
+           "GLOBAL_CONF", "install_shims", "__version__"]

@@ -1,0 +1,134 @@
+"""Course-code compatibility shims: run reference notebooks UNCHANGED.
+
+`install_shims()` registers this framework's modules under every import
+name the reference course uses —
+
+    pyspark.sql / pyspark.sql.functions / pyspark.sql.types
+    pyspark.ml{,.feature,.regression,.classification,.clustering,
+               .recommendation,.evaluation,.tuning,.linalg,.pipeline}
+    mlflow (+ .spark/.sklearn/.pyfunc/.tracking/.models.signature)
+    hyperopt (fmin/tpe/hp/Trials/SparkTrials/STATUS_OK)
+    sparkdl.xgboost (XgboostRegressor/Classifier)
+    databricks.koalas / databricks.feature_store / databricks.automl
+
+— so `from pyspark.ml.feature import StringIndexer` or
+`from databricks.feature_store import FeatureStoreClient` resolve to the
+TPU-native implementations. Only missing names are registered: a real
+pyspark/mlflow installation, if present, always wins (`setdefault`).
+
+Verified against the course's actual import census (every `from pyspark…`
+/ `databricks…` / `sparkdl…` / `hyperopt…` line in the reference tree).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from typing import Dict
+
+
+def _module(name: str, **attrs) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    return mod
+
+
+def _real_package(root: str) -> bool:
+    """True when an actual installation of `root` exists (imported or
+    merely installed): the shim must NEVER shadow or hybridize a real
+    package — 'a real installation always wins'."""
+    if root in sys.modules and not getattr(sys.modules[root],
+                                           "__sml_tpu_shim__", False):
+        return True
+    import importlib.util
+    try:
+        return importlib.util.find_spec(root) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def _register(mods: Dict[str, types.ModuleType]) -> None:
+    skipped_roots = {name.split(".")[0] for name in mods
+                     if "." not in name and _real_package(name)}
+    for name, mod in mods.items():
+        if name.split(".")[0] in skipped_roots:
+            continue  # real package present: leave its whole tree alone
+        mod.__sml_tpu_shim__ = True
+        sys.modules.setdefault(name, mod)
+        # wire submodule attributes so `import pyspark.sql.functions as F`
+        # and `pyspark.sql.functions.col` both resolve
+        if "." in name:
+            parent, _, child = name.rpartition(".")
+            if parent in sys.modules:
+                setattr(sys.modules[parent], child, sys.modules[name])
+
+
+def install_shims() -> None:
+    """Alias the framework under the course's import names (idempotent)."""
+    from . import frame, pandas_api, tracking, xgboost as xgb_mod
+    from . import automl as automl_mod
+    from . import feature_store as fs_mod
+    from .frame import functions as F
+    from .frame import types as T
+    from .frame.session import TpuSession as SparkSession
+    from .frame.dataframe import DataFrame
+    from .ml import base as ml_base
+    from .ml import (classification, clustering, evaluation, feature,
+                     linalg, recommendation, regression, tuning)
+    from . import tune as hyperopt_mod
+
+    pyspark = _module("pyspark", SparkSession=SparkSession)
+    sql = _module("pyspark.sql", SparkSession=SparkSession,
+                  DataFrame=DataFrame, functions=F, types=T, Row=T.Row)
+    ml = _module(
+        "pyspark.ml", Pipeline=ml_base.Pipeline,
+        PipelineModel=ml_base.PipelineModel,
+        Transformer=ml_base.Transformer, Estimator=ml_base.Estimator,
+        Model=ml_base.Model)
+    mods = {
+        "pyspark": pyspark,
+        "pyspark.sql": sql,
+        "pyspark.sql.functions": F,
+        "pyspark.sql.types": T,
+        "pyspark.sql.dataframe": _module("pyspark.sql.dataframe",
+                                         DataFrame=DataFrame),
+        "pyspark.ml": ml,
+        "pyspark.ml.pipeline": _module(
+            "pyspark.ml.pipeline", Pipeline=ml_base.Pipeline,
+            PipelineModel=ml_base.PipelineModel),
+        "pyspark.ml.feature": feature,
+        "pyspark.ml.regression": regression,
+        "pyspark.ml.classification": classification,
+        "pyspark.ml.clustering": clustering,
+        "pyspark.ml.recommendation": recommendation,
+        "pyspark.ml.evaluation": evaluation,
+        "pyspark.ml.tuning": tuning,
+        "pyspark.ml.linalg": linalg,
+        # hyperopt surface (ML 08/08L)
+        "hyperopt": hyperopt_mod,
+        # sparkdl xgboost surface (ML 11)
+        "sparkdl": _module("sparkdl", xgboost=xgb_mod),
+        "sparkdl.xgboost": xgb_mod,
+        # databricks namespaces (ML 09/10/14)
+        "databricks": _module("databricks", koalas=pandas_api,
+                              feature_store=fs_mod, automl=automl_mod),
+        "databricks.koalas": pandas_api,
+        "databricks.feature_store": fs_mod,
+        "databricks.automl": automl_mod,
+    }
+    _register(mods)
+    tracking.install_mlflow_shim()
+    # mlflow.models.signature / mlflow.tracking.client spellings
+    sys.modules.setdefault(
+        "mlflow.models", _module("mlflow.models",
+                                 signature=_module(
+                                     "mlflow.models.signature",
+                                     infer_signature=tracking.infer_signature,
+                                     ModelSignature=tracking.ModelSignature)))
+    sys.modules.setdefault("mlflow.models.signature",
+                           sys.modules["mlflow.models"].signature)
+    sys.modules.setdefault(
+        "mlflow.tracking.client",
+        _module("mlflow.tracking.client",
+                MlflowClient=tracking.MlflowClient))

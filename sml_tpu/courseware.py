@@ -69,6 +69,12 @@ class ClassroomSetup:
         self.widgets = dict(widgets or {})
         os.makedirs(self.working_dir, exist_ok=True)
         GLOBAL_CONF.set("sml.training.module-name", course_name)
+        # the course begins every notebook with `%run ./Includes/
+        # Classroom-Setup`; setting up the classroom therefore also aliases
+        # pyspark/mlflow/hyperopt/databricks to this framework, so lesson
+        # code below the setup cell runs unchanged (sml_tpu/compat.py)
+        from .compat import install_shims
+        install_shims()
         GLOBAL_CONF.set("sml.training.username", self.username)
         self.database = f"sml_{self.clean_username}_db"
         # CI hook: when run as a job, redirect tracking (Classroom-Setup:83-92)

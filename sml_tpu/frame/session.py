@@ -37,6 +37,8 @@ class Catalog:
         return dict(self._views_reg)
 
     def dropTempView(self, name: str) -> bool:
+        from .sql import invalidate_cached_relation
+        invalidate_cached_relation(self._session, name)
         return self._views_reg.pop(name, None) is not None
 
     def tableExists(self, name: str) -> bool:
@@ -85,6 +87,9 @@ class Catalog:
 
     def _drop_table(self, name: str) -> None:
         fq = self._qualify(name)
+        from .sql import invalidate_cached_relation
+        for n in {name, fq, fq.replace(".", "_"), name.split(".")[-1]}:
+            invalidate_cached_relation(self._session, n)
         info = self._tables_reg.pop(fq, None)
         if info:
             shutil.rmtree(info[0], ignore_errors=True)
