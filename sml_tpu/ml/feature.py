@@ -520,10 +520,37 @@ class RFormula(Estimator):
             raise ValueError(f"cannot parse formula {formula!r}")
         label, rhs = m.group(1), m.group(2)
         sch = {f.name: f.dataType.simpleString() for f in df.schema.fields}
-        if rhs.strip() == ".":
-            terms = [c for c in df.columns if c != label]
-        else:
-            terms = [t.strip() for t in rhs.split("+")]
+        # strict op/term parse — `term (+ term | - term)*`, R/Spark
+        # semantics where `-` EXCLUDES a term ("log_price ~ . - price",
+        # `Labs/ML 03L:84`). Unknown terms or malformed sequences raise:
+        # a formula that silently dropped or invented features would train
+        # a different model than the user wrote.
+        tokens = re.findall(r"[+-]|[^\s+-]+", rhs)
+        if not tokens or tokens[0] in "+-" or tokens[-1] in "+-":
+            raise ValueError(f"cannot parse formula {formula!r}")
+        included, excluded = [], []
+        op = "+"
+        for tok in tokens:
+            if tok in "+-":
+                if op is not None:
+                    raise ValueError(f"cannot parse formula {formula!r}")
+                op = tok
+                continue
+            if op is None:
+                raise ValueError(f"cannot parse formula {formula!r}")
+            if tok != "." and tok != label and tok not in sch:
+                raise ValueError(
+                    f"formula {formula!r} references unknown column {tok!r}")
+            (included if op == "+" else excluded).append(tok)
+            op = None
+        terms: List[str] = []
+        for t in included:
+            terms += [c for c in df.columns if c != label] if t == "." \
+                else [t]
+        seen = set()
+        terms = [t for t in terms
+                 if t not in set(excluded) and not
+                 (t in seen or seen.add(t))]
         str_terms = [t for t in terms if sch.get(t) == "string"]
         num_terms = [t for t in terms if t not in str_terms]
 
@@ -534,7 +561,7 @@ class RFormula(Estimator):
             ohe_cols = [f"{c}__ohe" for c in str_terms]
             invalid = self.getOrDefault("handleInvalid")
             si = StringIndexer(inputCols=str_terms, outputCols=idx_cols,
-                               handleInvalid="skip" if invalid == "skip" else "keep")
+                               handleInvalid=invalid)
             si_model = si.fit(df)
             indexed = si_model.transform(df)
             ohe = OneHotEncoder(inputCols=idx_cols, outputCols=ohe_cols)
@@ -542,10 +569,11 @@ class RFormula(Estimator):
             stages += [si_model, ohe_model]
             assembled += ohe_cols
         assembled += num_terms
+        # "error" must actually error on invalid rows (Spark contract);
+        # "skip" drops them; "keep" passes NaN through
         va = VectorAssembler(inputCols=assembled,
                              outputCol=self.getOrDefault("featuresCol"),
-                             handleInvalid="skip"
-                             if self.getOrDefault("handleInvalid") == "skip" else "keep")
+                             handleInvalid=self.getOrDefault("handleInvalid"))
         stages.append(va)
         model = RFormulaModel(stages=stages, label=label,
                               labelCol=self.getOrDefault("labelCol"))

@@ -581,3 +581,114 @@ def test_mle04_time_series(spark):
     fcst = m.predict(future)
     assert {"ds", "yhat"} <= set(fcst.columns)
     assert len(fcst) == len(t) + 10
+
+
+# ---------------------------------------------------------------------- labs
+def test_ml03L_rformula_log_price(spark, clean_dir):
+    """The lab's exact RFormula flow: `log_price ~ . - price` with skip
+    handling, predict in log space, exp back (`Labs/ML 03L:81-102`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import RFormula
+    from sml_tpu.ml.regression import LinearRegression
+    df = spark.read.format("delta").load(clean_dir) \
+        .select("room_type", "bedrooms", "accommodates", "price")
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    log_train_df = train_df.withColumn("log_price", F.log(F.col("price")))
+    log_test_df = test_df.withColumn("log_price", F.log(F.col("price")))
+    r_formula = RFormula(formula="log_price ~ . - price",
+                         featuresCol="features", labelCol="log_price",
+                         handleInvalid="skip")
+    lr = LinearRegression(labelCol="log_price", predictionCol="log_pred")
+    pipeline_model = Pipeline(stages=[r_formula, lr]).fit(log_train_df)
+    pred_df = pipeline_model.transform(log_test_df)
+    exp_df = pred_df.withColumn("prediction", F.exp(F.col("log_pred")))
+    rmse = RegressionEvaluator(labelCol="price").evaluate(exp_df)
+    assert 0 < rmse < 200
+    # the excluded column must NOT be a feature: room_type one-hots to
+    # (categories - 1) slots under dropLast, plus bedrooms + accommodates;
+    # price appearing as a feature would add one more slot
+    pdf = exp_df.toPandas()
+    width = pdf["features"].iloc[0].size
+    n_room_types = df.toPandas()["room_type"].nunique()
+    assert width == (n_room_types - 1) + 2
+
+
+def test_ml07L_cv_inside_pipeline(spark, clean_dir):
+    """The lab puts the CrossValidator INSIDE the pipeline
+    (`Labs/ML 07L:130-150`) — an estimator mid-chain must fit and its
+    model must transform."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import StringIndexer, VectorAssembler
+    from sml_tpu.ml.regression import RandomForestRegressor
+    from sml_tpu.ml.tuning import CrossValidator, ParamGridBuilder
+    df = spark.read.format("delta").load(clean_dir)
+    train_df, test_df = df.randomSplit([.8, .2], seed=42)
+    string_indexer = StringIndexer(inputCols=["room_type"],
+                                   outputCols=["room_typeIndex"],
+                                   handleInvalid="skip")
+    vec_assembler = VectorAssembler(
+        inputCols=["room_typeIndex", "bedrooms", "accommodates"],
+        outputCol="features")
+    rf = RandomForestRegressor(labelCol="price", seed=42, maxBins=40)
+    param_grid = (ParamGridBuilder()
+                  .addGrid(rf.getParam("maxDepth"), [2, 5])
+                  .addGrid(rf.getParam("numTrees"), [5, 10]).build())
+    evaluator = RegressionEvaluator(labelCol="price")
+    cv = CrossValidator(estimator=rf, evaluator=evaluator,
+                        estimatorParamMaps=param_grid, numFolds=3,
+                        parallelism=4, seed=42)
+    pipeline = Pipeline(stages=[string_indexer, vec_assembler, cv])
+    pipeline_model = pipeline.fit(train_df)
+    pred_df = pipeline_model.transform(test_df)
+    rmse = evaluator.evaluate(pred_df)
+    assert 0 < rmse < 200
+
+
+def test_ml08L_hyperopt_over_sklearn(spark, clean_dir):
+    """The lab's shape: fmin over a SINGLE-NODE sklearn objective
+    (`Labs/ML 08L:97-126`) — the payload is arbitrary Python."""
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+    from sklearn.model_selection import cross_val_score, train_test_split
+    from sml_tpu.tune import STATUS_OK, Trials, fmin, hp, tpe
+    pdf = spark.read.format("delta").load(clean_dir).toPandas()
+    X = pdf[["bedrooms", "accommodates"]].to_numpy()
+    y = pdf["price"].to_numpy()
+    X_train, _, y_train, _ = train_test_split(X, y, random_state=42)
+
+    def objective(params):
+        model = SkRF(n_estimators=int(params["n_estimators"]),
+                     max_depth=int(params["max_depth"]), random_state=42)
+        score = cross_val_score(model, X_train[:2000], y_train[:2000],
+                                cv=3, scoring="r2").mean()
+        return {"loss": -score, "status": STATUS_OK}
+
+    space = {"n_estimators": hp.quniform("n_estimators", 5, 20, 5),
+             "max_depth": hp.quniform("max_depth", 2, 6, 1)}
+    trials = Trials()
+    best = fmin(objective, space, algo=tpe, max_evals=4, trials=trials,
+                rstate=np.random.RandomState(42))
+    assert len(trials.trials) == 4 and "max_depth" in best
+
+
+def test_ml12L_sklearn_flavor_spark_udf(spark, clean_dir, tmp_path):
+    """The lab logs a single-node sklearn model and scores it at scale
+    through the pyfunc spark_udf (`Labs/ML 12L`)."""
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+    from sml_tpu import tracking as mlflow
+    mlflow.set_tracking_uri(str(tmp_path / "mlruns"))
+    pdf = spark.read.format("delta").load(clean_dir).toPandas()
+    Xcols = ["bedrooms", "accommodates"]
+    with mlflow.start_run() as run:
+        skm = SkRF(n_estimators=10, max_depth=4, random_state=42)
+        skm.fit(pdf[Xcols], pdf["price"])
+        mlflow.sklearn.log_model(skm, "sk-model")
+    predict = mlflow.pyfunc.spark_udf(spark,
+                                      f"runs:/{run.info.run_id}/sk-model")
+    df = spark.read.format("delta").load(clean_dir)
+    out = df.withColumn("prediction", predict(*Xcols)).toPandas()
+    assert np.isfinite(out["prediction"]).all()
+    ref = skm.predict(pdf[Xcols])
+    np.testing.assert_allclose(np.sort(out["prediction"].to_numpy()),
+                               np.sort(ref), rtol=1e-6)
