@@ -253,6 +253,13 @@ class DataFrame:
     def take(self, n: int) -> List[Row]:
         return self.limit(n).collect()
 
+    def tail(self, n: int) -> List[Row]:
+        """Last n rows as Rows (Spark's driver-collected tail)."""
+        pdf = self.toPandas()
+        out = DataFrame.from_pandas(pdf.iloc[max(0, len(pdf) - n):],
+                                    session=self._session, num_partitions=1)
+        return out.collect()
+
     def show(self, n: int = 20, truncate: bool = True) -> None:
         pdf = self.limit(n).toPandas()
         if truncate:

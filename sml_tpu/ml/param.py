@@ -95,6 +95,31 @@ class Params:
             return self._paramMap[param]
         return self._defaultParamMap.get(param)
 
+    def __getattr__(self, name: str):
+        """MLlib auto-generates `get<Param>()`/`set<Param>(v)` for every
+        declared param; synthesize the same accessors for any param that
+        has no explicit method (explicit defs win — this only runs when
+        normal lookup fails)."""
+        if name.startswith(("get", "set")) and len(name) > 3 and \
+                not name.startswith("__"):
+            pname = name[3].lower() + name[4:]
+            # hasParam uses getattr(self, pname) which re-enters here for
+            # unknown names and correctly raises below — no recursion
+            if self.hasParam(pname):
+                if name.startswith("get"):
+                    return lambda: self.getOrDefault(pname)
+
+                def setter(value, _pname=pname):
+                    # set(), not _set(): an explicit set<Param>(None) must
+                    # STORE None (PySpark semantics), while _set treats
+                    # None as "not passed"
+                    self.set(self.getParam(_pname), value)
+                    return self
+
+                return setter
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
     def get(self, param) -> Any:
         return self.getOrDefault(param)
 

@@ -57,6 +57,9 @@ class _ValidatorParams:
         self._declareParam("seed", default=None, doc="fold assignment seed")
         self._declareParam("parallelism", default=1, doc="concurrent trials")
         self._declareParam("collectSubModels", default=False, doc="keep sub-models")
+        # getEstimator/getEstimatorParamMaps/getEvaluator (the course reads
+        # them off both the validator and its model, `ML 07:154-159`) come
+        # from Params.__getattr__'s synthesized accessors
 
 
 def _fit_and_eval(est: Estimator, pmap, train, val, evaluator) -> float:

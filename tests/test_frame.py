@@ -252,3 +252,10 @@ def test_sql_dropped_view_errors_not_stale(spark, airbnb_pdf):
     spark.catalog.dropTempView("doomed_view")
     with pytest.raises((pandas.errors.DatabaseError, Exception)):
         spark.sql("SELECT count(*) n FROM doomed_view").toPandas()
+
+
+def test_tail(spark):
+    df = spark.createDataFrame(pd.DataFrame({"x": list(range(10))}))
+    rows = df.tail(3)
+    assert [r["x"] for r in rows] == [7, 8, 9]
+    assert len(df.tail(99)) == 10

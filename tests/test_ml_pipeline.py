@@ -211,3 +211,24 @@ def test_standard_scaler(spark):
     arr = np.array([v.toArray()[0] for v in out["scaled"]])
     assert arr.mean() == pytest.approx(0.0, abs=1e-6)
     assert arr.std(ddof=1) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_params_auto_accessors():
+    """MLlib auto-generates get<Param>/set<Param>; ours synthesizes them
+    for any declared param without an explicit method (param.py)."""
+    from sml_tpu.ml.recommendation import ALS
+    from sml_tpu.ml.regression import RandomForestRegressor
+
+    als = ALS(userCol="u", itemCol="i", ratingCol="r")
+    assert als.getUserCol() == "u"
+    assert als.getRatingCol() == "r"
+    rf = RandomForestRegressor()
+    rf.setMaxBins(64).setNumTrees(7)
+    assert rf.getMaxBins() == 64 and rf.getNumTrees() == 7
+    rf.setSeed(7)
+    rf.setSeed(None)
+    assert rf.getSeed() is None  # explicit None STORES None (PySpark)
+    with pytest.raises(AttributeError):
+        rf.getNotAParam()
+    with pytest.raises(AttributeError):
+        rf.totallyUnknown

@@ -184,3 +184,27 @@ def test_tpe_beats_random_on_known_surface():
     assert np.mean(tpe_scores) < np.mean(rand_scores), \
         (tpe_scores, rand_scores)
     assert np.median(tpe_scores) <= np.median(rand_scores)
+
+
+def test_validator_getters_on_cv_and_model(spark, airbnb_pdf):
+    """ML 07 reads getEstimatorParamMaps off the fitted cv_model to zip
+    with avgMetrics (`ML 07:154-159`)."""
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    from sml_tpu.ml.tuning import CrossValidator, ParamGridBuilder
+
+    df = spark.createDataFrame(airbnb_pdf)
+    fdf = VectorAssembler(inputCols=["bedrooms"],
+                          outputCol="features").transform(df)
+    lr = LinearRegression(labelCol="price")
+    grid = ParamGridBuilder().addGrid(lr.getParam("regParam"),
+                                      [0.0, 0.1]).build()
+    ev = RegressionEvaluator(labelCol="price")
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid, evaluator=ev,
+                        numFolds=2, seed=42)
+    assert cv.getEstimator() is lr and cv.getEvaluator() is ev
+    model = cv.fit(fdf)
+    pairs = list(zip(model.getEstimatorParamMaps(), model.avgMetrics))
+    assert len(pairs) == 2
+    assert all(np.isfinite(mv) for _, mv in pairs)
