@@ -42,6 +42,16 @@ def _split_rows(pdf: pd.DataFrame, n: int) -> Partitions:
     return [pdf.iloc[ix].reset_index(drop=True) for ix in idx]
 
 
+def _rows_from_pdf(pdf: pd.DataFrame) -> List[Row]:
+    cols = list(pdf.columns)
+    out = []
+    for t in pdf.itertuples(index=False):
+        vals = {c: (None if isinstance(v, float) and np.isnan(v) else v)
+                for c, v in zip(cols, t)}
+        out.append(Row(**vals))
+    return out
+
+
 def _concat(parts: Partitions) -> pd.DataFrame:
     parts = [p for p in parts if len(p.columns)]
     if not parts:
@@ -231,14 +241,7 @@ class DataFrame:
         return self._pdf_cache.copy(deep=False)
 
     def collect(self) -> List[Row]:
-        pdf = self.toPandas()
-        cols = list(pdf.columns)
-        out = []
-        for t in pdf.itertuples(index=False):
-            vals = {c: (None if isinstance(v, float) and np.isnan(v) else v)
-                    for c, v in zip(cols, t)}
-            out.append(Row(**vals))
-        return out
+        return _rows_from_pdf(self.toPandas())
 
     def first(self) -> Optional[Row]:
         rows = self.limit(1).collect()
@@ -255,10 +258,10 @@ class DataFrame:
 
     def tail(self, n: int) -> List[Row]:
         """Last n rows as Rows (Spark's driver-collected tail)."""
+        if n < 0:
+            raise ValueError(f"tail expects a non-negative n, got {n}")
         pdf = self.toPandas()
-        out = DataFrame.from_pandas(pdf.iloc[max(0, len(pdf) - n):],
-                                    session=self._session, num_partitions=1)
-        return out.collect()
+        return _rows_from_pdf(pdf.iloc[max(0, len(pdf) - n):])
 
     def show(self, n: int = 20, truncate: bool = True) -> None:
         pdf = self.limit(n).toPandas()

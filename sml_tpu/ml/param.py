@@ -110,13 +110,18 @@ class Params:
                     return lambda: self.getOrDefault(pname)
 
                 def setter(value, _pname=pname):
-                    # set(), not _set(): an explicit set<Param>(None) must
-                    # STORE None (PySpark semantics), while _set treats
-                    # None as "not passed"
-                    self.set(self.getParam(_pname), value)
+                    # same _set semantics as every explicit setter in the
+                    # codebase (None means "leave unset") — one setter
+                    # contract everywhere beats a PySpark corner case that
+                    # no course code exercises
+                    self._set(**{_pname: value})
                     return self
 
                 return setter
+        # NOTE: a property whose body raises AttributeError lands here and
+        # gets re-reported as a missing attribute (Python swallows the
+        # original before calling __getattr__) — properties on Params
+        # subclasses should raise RuntimeError for internal errors
         raise AttributeError(
             f"{type(self).__name__!r} object has no attribute {name!r}")
 

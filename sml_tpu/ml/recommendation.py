@@ -163,6 +163,11 @@ class ALSModel(Model):
 
     @property
     def rank(self) -> int:
+        if self._uf is None:
+            # RuntimeError, not AttributeError: an AttributeError from a
+            # property body would be re-reported by Params.__getattr__ as
+            # "no attribute rank", hiding the real problem
+            raise RuntimeError("ALSModel has no factors (not fitted)")
         return int(self._uf.shape[1])
 
     @property

@@ -227,7 +227,7 @@ def test_params_auto_accessors():
     assert rf.getMaxBins() == 64 and rf.getNumTrees() == 7
     rf.setSeed(7)
     rf.setSeed(None)
-    assert rf.getSeed() is None  # explicit None STORES None (PySpark)
+    assert rf.getSeed() == 7  # None = "leave unset", like explicit setters
     with pytest.raises(AttributeError):
         rf.getNotAParam()
     with pytest.raises(AttributeError):
