@@ -49,6 +49,33 @@ def _enable_persistent_compile_cache() -> None:
 
 _enable_persistent_compile_cache()
 
+
+def _require_pandas_cow() -> None:
+    """The frame layer's shallow-copy memoization (`toPandas` caching,
+    `pdf.copy(deep=False)` views) is only mutation-safe under pandas
+    copy-on-write. pandas>=3 has CoW always-on; on 2.x we enable the mode
+    explicitly — a deliberate PROCESS-GLOBAL flip (it is pandas 3.x
+    semantics, and the frame layer deep-copies defensively if someone
+    turns it back off) — and anything older is refused (ADVICE r3: an
+    in-place mutation of a returned frame must never corrupt a cached
+    parent)."""
+    import pandas as pd
+    major = int(pd.__version__.split(".")[0])
+    if major >= 3:
+        return
+    if major < 2:  # 1.5's experimental CoW is incomplete: refuse outright
+        raise ImportError(
+            f"sml_tpu requires pandas>=2.0 (found {pd.__version__})")
+    try:
+        pd.options.mode.copy_on_write = True
+    except (AttributeError, KeyError):
+        raise ImportError(
+            f"sml_tpu requires pandas>=2.0 with copy-on-write "
+            f"(found {pd.__version__})")
+
+
+_require_pandas_cow()
+
 from .conf import GLOBAL_CONF
 from .frame import DataFrame, Row, TpuSession, functions, get_session
 from .version import __version__

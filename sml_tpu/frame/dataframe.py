@@ -238,6 +238,11 @@ class DataFrame:
         costs one concat total instead of one per call."""
         if self._pdf_cache is None:
             self._pdf_cache = _concat(self._materialize()).reset_index(drop=True)
+        if int(pd.__version__.split(".")[0]) < 3 \
+                and not pd.options.mode.copy_on_write:
+            # someone disabled the CoW mode the package enabled at import:
+            # a shallow copy would share mutable buffers with the cache
+            return self._pdf_cache.copy(deep=True)
         return self._pdf_cache.copy(deep=False)
 
     def collect(self) -> List[Row]:

@@ -60,10 +60,21 @@ class Catalog:
         self._databases.add(name)
         os.makedirs(os.path.join(self._warehouse, name + ".db"), exist_ok=True)
 
+    def _invalidate_table(self, fq: str, path: Optional[str]) -> None:
+        """Purge every session-SQL relation derived from a dropped table:
+        its name aliases AND the path-keyed `_tt_*`/`_delta_*` snapshots,
+        which otherwise survive a drop+recreate at the same path."""
+        from .sql import invalidate_cached_path, invalidate_cached_relation
+        for n in {fq, fq.replace(".", "_"), fq.split(".")[-1]}:
+            invalidate_cached_relation(self._session, n)
+        if path:
+            invalidate_cached_path(self._session, path)
+
     def _drop_database(self, name: str) -> None:
         self._databases.discard(name)
         for fq in [k for k in self._tables_reg if k.startswith(name + ".")]:
-            self._tables_reg.pop(fq)
+            path, _fmt = self._tables_reg.pop(fq)
+            self._invalidate_table(fq, path)
         shutil.rmtree(os.path.join(self._warehouse, name + ".db"), ignore_errors=True)
 
     def _use_database(self, name: str) -> None:
@@ -88,9 +99,9 @@ class Catalog:
     def _drop_table(self, name: str) -> None:
         fq = self._qualify(name)
         from .sql import invalidate_cached_relation
-        for n in {name, fq, fq.replace(".", "_"), name.split(".")[-1]}:
-            invalidate_cached_relation(self._session, n)
+        invalidate_cached_relation(self._session, name)  # as-typed alias
         info = self._tables_reg.pop(fq, None)
+        self._invalidate_table(fq, info[0] if info else None)
         if info:
             shutil.rmtree(info[0], ignore_errors=True)
 

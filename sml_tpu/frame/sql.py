@@ -122,6 +122,26 @@ def invalidate_cached_relation(session, name: str) -> None:
             pass
 
 
+def invalidate_cached_path(session, path: str) -> None:
+    """Drop every materialized relation derived from `path` — the `_tt_*`
+    time-travel and `_delta_*` snapshots carry (path, ...) tuple tokens
+    that stay constant across a drop+recreate at the same path, so a
+    name-only invalidation would leave pre-drop snapshots live (ADVICE r3:
+    `VERSION AS OF n` after recreate must not see the old table)."""
+    st = getattr(session, "_sql_state", None)
+    if st is None:
+        return
+    with st["lock"]:
+        stale = [n for n, tok in st["tokens"].items()
+                 if isinstance(tok, tuple) and tok and tok[0] == path]
+        for n in stale:
+            st["tokens"].pop(n, None)
+            try:
+                st["con"].execute(f'DROP TABLE IF EXISTS "{n}"')
+            except sqlite3.Error:
+                pass
+
+
 def _materialize_cached(st, name: str, token, loader) -> None:
     """Load `name` into the session db unless the same `token` already did.
     Tokens compare by identity for frames (immutable once registered) and

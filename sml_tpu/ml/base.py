@@ -191,6 +191,12 @@ def _attach_fused_features(cur, fitted_transforms, est, raw_pdf):
             return cur
         if est.getOrDefault("labelCol") not in raw_pdf.columns:
             return cur
+        # Shared guard with featurizer.try_fast_fit: if any prep stage
+        # overwrites labelCol/weightCol, raw_pdf holds PRE-transform labels
+        # and the fused path would silently train a different model.
+        from .featurizer import prep_overwrites_label
+        if prep_overwrites_label(fitted_transforms[:-1], est):
+            return cur
         X, keep = feat.transform_with_mask(raw_pdf)
         cur._featurized = {assembler.getOrDefault("outputCol"):
                            (X, keep, raw_pdf)}

@@ -258,11 +258,53 @@ def try_fast_fit(stages, raw_pdf, make_frame):
     correct); the caller runs the estimator fit itself so estimator errors
     propagate unmasked.
     """
+    if len(stages) < 2 or raw_pdf is None:
+        return None
+    return _try_fast_fit(stages, raw_pdf, make_frame)
+
+
+def prep_overwrites_label(prep_stages, est) -> bool:
+    """True when any prep stage's OUTPUT columns collide with the
+    estimator's labelCol/weightCol — the fused fast paths read labels from
+    the RAW pandas, so a stage that rewrites the label there would make
+    them train on pre-transform values. Stages with output params UNSET
+    write in place (Imputer's outputCols default to inputCols), so the
+    input columns count as produced in that case (r4 review)."""
+    produced = set()
+    for st in prep_stages:
+        outs = set()
+        for attr in ("outputCols", "outputCol"):
+            try:
+                v = st.getOrDefault(attr)
+            except Exception:
+                v = None
+            if isinstance(v, str):
+                outs.add(v)
+            elif v:
+                outs.update(v)
+        if not outs:  # no explicit outputs: the stage overwrites its inputs
+            for attr in ("inputCols", "inputCol"):
+                try:
+                    v = st.getOrDefault(attr)
+                except Exception:
+                    v = None
+                if isinstance(v, str):
+                    outs.add(v)
+                elif v:
+                    outs.update(v)
+        produced |= outs
+    label_like = {est.getOrDefault("labelCol")}
+    if est.hasParam("weightCol"):
+        w = est.getOrDefault("weightCol")
+        if w:
+            label_like.add(w)
+    return bool(produced & label_like)
+
+
+def _try_fast_fit(stages, raw_pdf, make_frame):
     from .base import Estimator
     from .feature import (Imputer, OneHotEncoder, OneHotEncoderModel,
                           StringIndexer, VectorAssembler)
-    if len(stages) < 2 or raw_pdf is None:
-        return None
     *prep, est = stages
     if not isinstance(est, Estimator):
         return None
@@ -275,23 +317,7 @@ def try_fast_fit(stages, raw_pdf, make_frame):
         return None
     if est.getOrDefault("labelCol") not in raw_pdf.columns:
         return None
-    produced = set()
-    for st in prep[:-1]:
-        for attr in ("outputCols", "outputCol"):
-            try:
-                v = st.getOrDefault(attr)
-            except Exception:
-                v = None
-            if isinstance(v, str):
-                produced.add(v)
-            elif v:
-                produced.update(v)
-    label_like = {est.getOrDefault("labelCol")}
-    if est.hasParam("weightCol"):
-        w = est.getOrDefault("weightCol")
-        if w:
-            label_like.add(w)
-    if produced & label_like:
+    if prep_overwrites_label(prep[:-1], est):
         return None  # a prep stage rewrites the label: raw labels are wrong
 
     raw_frame = make_frame(raw_pdf)

@@ -171,10 +171,10 @@ def preroute(hint: Optional[WorkHint]) -> Optional[str]:
     if _default_backend() == "cpu":
         return "device"  # no tunnel: the active mesh IS the host
     mode = str(GLOBAL_CONF.get("sml.dispatch.mode"))
+    if mode == "host":  # forced host must also catch unhinted programs
+        return "host"
     if mode == "device" or hint is None:
         return "device"
-    if mode == "host":
-        return "host"
     if CALIBRATION.ensure().rt_fixed <= 1e-3:  # locally attached chip
         return "device"
     return None
@@ -203,8 +203,9 @@ def mesh_for(hint: Optional[WorkHint]):
     """Pick the execution mesh for one program invocation.
 
     Returns the active mesh (accelerator / placed submesh) or the host
-    mesh. With no hint, or on a CPU-backend process (no tunnel), this is
-    just `get_mesh()`.
+    mesh. On a CPU-backend process (no tunnel) this is just `get_mesh()`;
+    with no hint it is `get_mesh()` UNLESS sml.dispatch.mode=host, which
+    forces the host mesh even for unhinted programs.
     """
     route, _ = decide(hint)
     return meshlib.get_mesh() if route == "device" else host_mesh()

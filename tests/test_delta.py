@@ -123,3 +123,24 @@ def test_sql_version_as_of(spark, tmp_path):
     vt = spark.sql(
         f"SELECT * FROM delta.`{p}` TIMESTAMP AS OF '{ts}'").toPandas()
     assert sorted(vt["x"].tolist()) == [10, 20, 30]
+
+
+def test_drop_recreate_invalidates_time_travel_cache(spark):
+    """DROP TABLE then recreate at the same warehouse path must not serve
+    pre-drop snapshots from the session SQL store (ADVICE r3): the cached
+    `_tt_*` relations carry path-keyed tokens that survive a name-only
+    invalidation."""
+    import pandas as pd
+    spark.createDataFrame(pd.DataFrame({"x": [1, 2]})) \
+        .write.format("delta").mode("overwrite").saveAsTable("tt_cycle")
+    p = spark.catalog._table_path("tt_cycle")
+    old = spark.sql(
+        f"SELECT * FROM delta.`{p}` VERSION AS OF 0").toPandas()
+    assert sorted(old["x"].tolist()) == [1, 2]
+    spark.sql("DROP TABLE tt_cycle")
+    spark.createDataFrame(pd.DataFrame({"x": [7, 8, 9]})) \
+        .write.format("delta").mode("overwrite").saveAsTable("tt_cycle")
+    fresh = spark.sql(
+        f"SELECT * FROM delta.`{p}` VERSION AS OF 0").toPandas()
+    assert sorted(fresh["x"].tolist()) == [7, 8, 9]
+    spark.sql("DROP TABLE tt_cycle")

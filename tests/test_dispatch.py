@@ -61,6 +61,56 @@ def test_no_hint_routes_device(tunneled):
     assert dispatch.decide(None)[0] == "device"
 
 
+def test_forced_host_wins_for_unhinted_programs(tunneled):
+    """sml.dispatch.mode=host must beat the hint-is-None device fallback —
+    'host: always the host mesh' is the conf's contract (ADVICE r3)."""
+    GLOBAL_CONF.set("sml.dispatch.mode", "host")
+    try:
+        assert dispatch.decide(None) == ("host", False)
+        assert dispatch.preroute(None) == "host"
+    finally:
+        GLOBAL_CONF.set("sml.dispatch.mode", "auto")
+
+
+def test_large_array_fingerprint_sees_point_edits():
+    """A >16MB array's staging fingerprint must change when a single
+    element changes anywhere — including outside the 16 sampled windows
+    (ADVICE r3 medium: delta UPDATE then re-fit must not reuse stale
+    device data)."""
+    rng = np.random.default_rng(7)
+    a = rng.normal(size=(6_000_000,)).astype(np.float32)  # 24MB
+    assert a.nbytes > _staging._FULL_HASH_MAX_BYTES
+    k0 = _staging._content_key(a)
+    # flip one element strictly between two sampled windows, asserted so:
+    # without the whole-array checksum this edit is invisible to the key
+    edit = 1_000_000
+    byte = edit * a.itemsize
+    starts = np.linspace(0, a.nbytes - _staging._SAMPLE_WINDOW,
+                         _staging._SAMPLE_COUNT).astype(np.int64)
+    assert not any(s <= byte < s + _staging._SAMPLE_WINDOW
+                   and s <= byte + a.itemsize - 1 < s + _staging._SAMPLE_WINDOW
+                   for s in starts) and not any(
+        s <= byte < s + _staging._SAMPLE_WINDOW for s in starts)
+    b = a.copy()
+    b[edit] += 1.0
+    assert _staging._content_key(b) != k0
+    # row permutation outside every window must also change the key — a
+    # commutative checksum would serve stale pre-shuffle device data
+    # against freshly-extracted labels (r4 review)
+    c = a.copy().reshape(1_500_000, 4)
+    c[[100_000, 100_001]] = c[[100_001, 100_000]]
+    c = np.ascontiguousarray(c.reshape(-1))
+    assert _staging._content_key(c) != k0
+    # compensating ± edits of two aligned words must not cancel
+    d = a.copy()
+    dv = d.view(np.uint64)
+    dv[500_000] += np.uint64(999)
+    dv[500_007] -= np.uint64(999)
+    assert _staging._content_key(d) != k0
+    # deterministic across identical copies
+    assert _staging._content_key(a.copy()) == k0
+
+
 def test_cpu_backend_short_circuits(monkeypatch):
     monkeypatch.setattr(dispatch, "_default_backend", lambda: "cpu")
     assert dispatch.decide(WorkHint(flops=1.0))[0] == "device"

@@ -156,3 +156,40 @@ def test_fused_pipeline_fit_matches_generic_path(monkeypatch):
     r1 = ev.evaluate(m_fast.transform(test))
     r2 = ev.evaluate(m_generic.transform(test))
     assert abs(r1 - r2) < 1e-9, (r1, r2)
+
+
+@pytest.mark.parametrize("explicit_outputs", [True, False])
+def test_fused_fit_skips_when_prep_overwrites_label(explicit_outputs):
+    """A prep stage that rewrites labelCol must force the generic path —
+    the fused extract_xy reads labels from the RAW pandas and would
+    otherwise train on pre-transform (NaN) labels (ADVICE r3). Covers both
+    the explicit outputCols=['label'] form and the IN-PLACE form where
+    outputCols is unset and Imputer defaults to overwriting inputCols
+    (r4 review)."""
+    from sml_tpu.ml.feature import Imputer
+
+    pdf = _data(n=2000, seed=13, nan_rate=0)
+    pdf.loc[::10, "label"] = np.nan
+    df = get_session().createDataFrame(pdf)
+    imp = (Imputer(inputCols=["label"], outputCols=["label"],
+                   strategy="median") if explicit_outputs
+           else Imputer(inputCols=["label"], strategy="median"))
+    pipe = Pipeline(stages=[
+        imp,
+        VectorAssembler(inputCols=["x1", "x2"], outputCol="features"),
+        LinearRegression(labelCol="label"),
+    ])
+    model = pipe.fit(df)
+    lr = model.stages[-1]
+    coef = np.asarray(lr.coefficients.toArray(), dtype=float)
+    assert np.all(np.isfinite(coef)) and np.isfinite(lr.intercept)
+    # Generic reference: impute the label on host first, then fit without
+    # any label-touching prep stage.
+    ref_pdf = pdf.copy()
+    ref_pdf["label"] = ref_pdf["label"].fillna(ref_pdf["label"].median())
+    ref = Pipeline(stages=[
+        VectorAssembler(inputCols=["x1", "x2"], outputCol="features"),
+        LinearRegression(labelCol="label"),
+    ]).fit(get_session().createDataFrame(ref_pdf)).stages[-1]
+    np.testing.assert_allclose(coef, ref.coefficients.toArray(), rtol=1e-5)
+    np.testing.assert_allclose(lr.intercept, ref.intercept, rtol=1e-5)
