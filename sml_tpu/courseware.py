@@ -209,17 +209,27 @@ def make_movielens_dataset(n_users: int = 1000, n_items: int = 400,
 
 def make_dedup_dataset(n: int = 103000, n_unique: int = 100000,
                        seed: int = 11) -> pd.DataFrame:
+    """people-with-dups-shaped table (`Labs/ML 00L:30-38`): the lab file's
+    full colon-separated schema. Duplicate rows vary only in name CASE and
+    ssn FORMAT (hyphenated vs not), exactly the two normalizations the
+    lab's dedup must apply; names/birthDate/salary otherwise match."""
     rng = np.random.default_rng(seed)
-    first = [f"Person{i}" for i in range(n_unique)]
+    idx = np.arange(n_unique)
     pdf = pd.DataFrame({
-        "firstName": first,
-        "lastName": [f"Family{i % 977}" for i in range(n_unique)],
+        "firstName": [f"Person{i}" for i in idx],
+        "middleName": [f"M{i % 409}" for i in idx],
+        "lastName": [f"Family{i % 977}" for i in idx],
+        "gender": np.where(idx % 2 == 0, "F", "M"),
+        "birthDate": [f"{1950 + i % 50}-{1 + i % 12:02d}-{1 + i % 28:02d}"
+                      for i in idx],
+        "salary": (35000 + (idx * 7919) % 150000).astype(np.int64),
         "ssn": [f"{900 + i // 10000:03d}-{(i // 100) % 100:02d}-{i % 10000:04d}"
-                for i in range(n_unique)],
+                for i in idx],
     })
     dup_idx = rng.choice(n_unique, n - n_unique, replace=False)
     dups = pdf.iloc[dup_idx].copy()
     dups["firstName"] = dups["firstName"].str.upper()  # case variants
+    dups["middleName"] = dups["middleName"].str.lower()
     dups["ssn"] = dups["ssn"].str.replace("-", "", regex=False)
     out = pd.concat([pdf, dups], ignore_index=True)
     return out.sample(frac=1.0, random_state=seed).reset_index(drop=True)
@@ -234,13 +244,31 @@ class TestResults:
 
     @staticmethod
     def to_hash(value) -> int:
-        """Stable hash via the engine's Murmur3 kernel (the course hashes
-        answers with Spark's `hash()` — `Class-Utility-Methods.py:161-165`)."""
+        """Spark-parity answer hash: `abs(hash(str(value)))` exactly as the
+        course computes it (`Class-Utility-Methods.py:161-165`). The
+        engine's Murmur3 kernel reproduces Spark's `hash()` bit-for-bit —
+        anchored by the course's own hardcoded constants
+        (`Labs/ML 00L - Dedup Lab.py:89-90`): hash("8") == 1276280174,
+        hash("100000") == 972882115 after abs."""
         s = pd.Series([str(value)])
-        return int(hash_columns([s], n=1)[0])
+        h = int(hash_columns([s], n=1)[0])
+        # Java Math.abs(Integer.MIN_VALUE) == Integer.MIN_VALUE
+        return h if h == -(1 << 31) else abs(h)
+
+    @staticmethod
+    def _answer_str(answer) -> str:
+        """The course's stringification (`Class-Utility-Methods.py:197-203`):
+        None → "null", booleans lowercase, everything else str()."""
+        if answer is None:
+            return "null"
+        if answer is True:
+            return "true"
+        if answer is False:
+            return "false"
+        return str(answer)
 
     def validate_your_answer(self, what: str, expected_hash: int, answer) -> bool:
-        got = self.to_hash(answer)
+        got = self.to_hash(self._answer_str(answer))
         passed = got == expected_hash
         self.results.append({"what": what, "passed": passed,
                              "expected": expected_hash, "got": got})

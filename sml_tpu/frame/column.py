@@ -259,12 +259,19 @@ class CaseWhenColumn(Column):
 
 
 class NamedColumn(Column):
-    """Reference to an existing column by name."""
+    """Reference to an existing column by name. `col("*")` is the star
+    reference (`Solutions/Labs/ML 00L`: `df.select(col("*"), ...)`) —
+    select() expands it to all input columns; evaluating it anywhere else
+    is an error."""
 
     def __init__(self, name: str):
         if name == "*":
-            raise ValueError("use df.select('*') directly")
-        super().__init__(lambda pdf, ctx: pdf[name], name)
+            def star_eval(pdf, ctx):
+                raise ValueError(
+                    "col('*') can only be expanded inside select()")
+            super().__init__(star_eval, name)
+        else:
+            super().__init__(lambda pdf, ctx: pdf[name], name)
         self.ref = name
 
 

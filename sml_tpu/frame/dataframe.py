@@ -239,7 +239,8 @@ class DataFrame:
         if self._pdf_cache is None:
             self._pdf_cache = _concat(self._materialize()).reset_index(drop=True)
         if int(pd.__version__.split(".")[0]) < 3 \
-                and not pd.options.mode.copy_on_write:
+                and pd.options.mode.copy_on_write is not True:
+            # "warn" keeps legacy write-through semantics: not CoW-safe
             # someone disabled the CoW mode the package enabled at import:
             # a shallow copy would share mutable buffers with the cache
             return self._pdf_cache.copy(deep=True)
@@ -289,7 +290,8 @@ class DataFrame:
         def fn(pdf: pd.DataFrame, ctx: EvalContext) -> pd.DataFrame:
             out: Dict[str, pd.Series] = {}
             for c in cols:
-                if isinstance(c, str) and c == "*":
+                if (isinstance(c, str) and c == "*") or \
+                        (isinstance(c, NamedColumn) and c.ref == "*"):
                     for name in pdf.columns:
                         out[name] = pdf[name]
                     continue

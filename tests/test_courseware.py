@@ -33,6 +33,7 @@ def test_dedup_dataset_shape():
     # case/format-normalized dedup recovers the unique count (ML 00L)
     norm = pdf.assign(
         firstName=pdf["firstName"].str.lower(),
+        middleName=pdf["middleName"].str.lower(),
         ssn=pdf["ssn"].str.replace("-", "", regex=False))
     assert len(norm.drop_duplicates()) == 1000
 

@@ -5,14 +5,48 @@ from sml_tpu.native import hashing
 from sml_tpu.native.build import load_library
 
 
-def test_known_murmur3_vectors():
-    """Golden vectors for Murmur3_x86_32 with per-trailing-byte tail and seed
-    chaining (int path is the standard single-block murmur3)."""
-    # standard murmur3_32("", seed) finalization over ints
-    assert hashing.hash_scalar(np.int64(0)) == hashing.hash_scalar(np.int64(0))
-    a = hashing.hash_scalar(np.int64(1))
-    b = hashing.hash_scalar(np.int64(2))
-    assert a != b
+def test_spark_hash_course_constants():
+    """The ONLY Spark-computed ground truth in this image: the course's
+    hardcoded answer hashes (`Labs/ML 00L - Dedup Lab.py:89-90`, harness at
+    `Includes/Class-Utility-Methods.py:161-211`). Spark evaluates
+    `abs(hash(value)).cast("int")` with hash = Murmur3_x86_32(seed=42)
+    over UTF-8 bytes with Spark's per-trailing-byte tail mix. If our
+    Murmur3 drifts from Spark's in seed chaining, tail handling, or sign
+    treatment, these externally-anchored vectors fail."""
+    from sml_tpu.courseware import toHash
+
+    assert toHash("8") == 1276280174
+    assert toHash("100000") == 972882115
+    # raw signed values feeding the abs (both negative in Spark)
+    assert hashing._py_hash_bytes(b"8", hashing.SEED) == -1276280174
+    assert hashing._py_hash_bytes(b"100000", hashing.SEED) == -972882115
+    # the vectorized column kernel (native or numpy) agrees with the
+    # scalar reference on the anchored vectors
+    col = hashing.hash_column(pd.Series(["8", "100000"]),
+                              np.full(2, hashing.SEED, dtype=np.int32))
+    assert col.tolist() == [-1276280174, -972882115]
+
+
+def test_murmur3_regression_pins():
+    """Self-derived pins for the int/long/double/string paths — regression
+    detectors for byte-order, width, and sign-extension changes (the
+    string path's external anchor is test_spark_hash_course_constants)."""
+    seeds = np.full(1, 42, dtype=np.int32)
+    assert hashing._np_hash_int(np.array([0], np.int32), seeds.copy())[0] \
+        == hashing._np_hash_int(np.array([0], np.int32), seeds.copy())[0]
+    pins = {
+        ("int", 0): int(hashing._np_hash_int(np.array([0], np.int32),
+                                             seeds.copy())[0]),
+        ("long", 0): int(hashing._np_hash_long(np.array([0], np.int64),
+                                               seeds.copy())[0]),
+    }
+    assert pins[("int", 0)] != pins[("long", 0)]  # widths hash differently
+    # byte-level goldens for the string kernel, covering 0-3 tail bytes
+    # and sign-extension of high bytes (values pinned from this
+    # implementation, which the course constants anchor externally)
+    assert hashing._py_hash_bytes(b"", 42) == 142593372
+    assert hashing._py_hash_bytes(b"abcd", 42) == -396302900
+    assert hashing._py_hash_bytes("ü".encode("utf-8"), 42) == -1098725648
 
 
 def test_int_long_double_consistency():
