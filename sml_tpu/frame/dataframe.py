@@ -95,9 +95,25 @@ def coerce_to_schema(pdf: pd.DataFrame, schema: StructType) -> pd.DataFrame:
 
 
 class DataFrame:
+    # real class attribute so `getattr(df, "isStreaming", False)` probes see
+    # False instead of __getattr__'s NamedColumn fallback (which is TRUTHY —
+    # it silently disabled every isStreaming-guarded fast path, r4)
+    isStreaming = False
+
     def __init__(self, compute: Callable[[], Partitions],
                  session: Optional["TpuSession"] = None,
-                 schema: Optional[StructType] = None):
+                 schema: Optional[StructType] = None,
+                 op: Optional[str] = None):
+        if op is None:
+            # default tag: the engine method that built this frame — names
+            # the `materialize.<op>` profiler spans (MLE 05-style per-op
+            # engine observability) without threading labels everywhere
+            import sys as _sys
+            op = _sys._getframe(1).f_code.co_name
+            if op in ("_derive", "_derive_rowlocal", "from_pandas",
+                      "from_partitions"):
+                op = _sys._getframe(2).f_code.co_name
+        self._op = op
         self._compute = compute
         self._session = session
         self._schema_hint = schema
@@ -128,7 +144,7 @@ class DataFrame:
 
     def _materialize(self) -> Partitions:
         if self._parts is None:
-            with PROFILER.span("materialize"):
+            with PROFILER.span(f"materialize.{self._op}"):
                 self._parts = self._compute()
                 if not self._parts:
                     self._parts = [pd.DataFrame()]
@@ -577,7 +593,9 @@ class DataFrame:
                 mask = (u >= lo) & (u < hi)
                 return pdf[mask].reset_index(drop=True)
 
-            return parent._derive(fn)
+            out = parent._derive(fn)
+            out._op = "randomSplit"
+            return out
 
         return [make(i) for i in range(len(weights))]
 

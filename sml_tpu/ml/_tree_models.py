@@ -98,23 +98,27 @@ class _EnsembleSpec:
         return self._stacked
 
     def predict_margin(self, X: np.ndarray) -> np.ndarray:
-        binned = bin_with(X, self.binning)
+        from ..utils.profiler import PROFILER
+        with PROFILER.span("binning.predict", rows=int(X.shape[0])):
+            binned = bin_with(X, self.binning)
         n = binned.shape[0]
         from ._staging import route_for_arrays
         hint = dispatch.WorkHint(
             flops=4.0 * n * len(self.trees) * self.depth, kind="scatter",
             out_bytes=4.0 * n)
         mesh, route = route_for_arrays(hint, binned)
-        if route == "device":
-            # rows shard over the mesh; tree tensors replicate (P8 path)
-            from .inference import predict_forest_sharded
-            sf, sb, lv, w = self.stacked()
-            return predict_forest_sharded(binned, sf, sb, lv, w, self.depth,
-                                          base=self.base)
-        import jax
-        with jax.default_device(list(mesh.devices.flat)[0]):
-            return self.base + predict_forest(binned, self.trees, self.depth,
-                                              self.tree_weights)
+        with PROFILER.span("program.forest_predict", rows=n, route=route):
+            if route == "device":
+                # rows shard over the mesh; tree tensors replicate (P8 path)
+                from .inference import predict_forest_sharded
+                sf, sb, lv, w = self.stacked()
+                return predict_forest_sharded(binned, sf, sb, lv, w,
+                                              self.depth, base=self.base)
+            import jax
+            with jax.default_device(list(mesh.devices.flat)[0]):
+                return self.base + predict_forest(binned, self.trees,
+                                                  self.depth,
+                                                  self.tree_weights)
 
     def save(self, path: str) -> None:
         remap_keys = sorted(self.binning.cat_remap)

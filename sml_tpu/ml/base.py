@@ -318,10 +318,134 @@ class PipelineModel(Model):
         self.stages: List[Transformer] = stages or []
 
     def _transform(self, df):
+        fast = self._fast_transform(df)
+        if fast is not None:
+            return fast
         cur = df
         for s in self.stages:
             cur = s.transform(cur)
         return cur
+
+    def _fast_plan(self):
+        """Compile (featurizer, scorer, assembler, tail) for the fused
+        transform, memoized per stage list. `scorer` is None for a pure
+        feature pipeline (no final model); a plan of None means the stage
+        shapes don't fit and the generic per-stage path must run."""
+        token = tuple((id(s), type(s).__name__,
+                       getattr(s, "_param_version", 0))
+                      for s in self.stages)
+        cached = getattr(self, "_fast_plan_cache", None)
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        plan = self._build_fast_plan()
+        self._fast_plan_cache = (token, plan)
+        return plan
+
+    def _build_fast_plan(self):
+        from .feature import VectorAssembler
+        from .featurizer import CompiledFeaturizer
+        from .regression import LinearRegressionModel
+        from ._tree_models import _TreeRegressionModel
+        stages = self.stages
+        if not stages:
+            return None
+        tail = stages[-1]
+        prep = stages
+        scorer = None
+        if isinstance(tail, (LinearRegressionModel, _TreeRegressionModel)):
+            # regression tails append EXACTLY predictionCol — classifiers
+            # (probability/rawPrediction columns) keep the generic path
+            prep = stages[:-1]
+        else:
+            tail = None
+        if not prep or not isinstance(prep[-1], VectorAssembler):
+            return None
+        assembler = prep[-1]
+        feat = CompiledFeaturizer.from_stages(prep[:-1], assembler)
+        if feat is None:
+            return None
+        if tail is not None:
+            if tail.getOrDefault("featuresCol") != \
+                    assembler.getOrDefault("outputCol"):
+                return None
+            from .inference import DeviceScorer
+            try:
+                scorer = DeviceScorer(tail)
+            except TypeError:
+                return None
+        return feat, scorer, assembler, tail
+
+    def _fast_transform(self, df):
+        """Whole-pipeline fused TRANSFORM (the serving twin of the fused
+        fit): for the standard course chain the entire stage sequence —
+        feature prep, assembly, model predict — runs as ONE columnar pass
+        over the parent's pandas plus one routed predict program, instead
+        of materializing an intermediate frame per stage (r3 VERDICT #1:
+        41s of the 40s benchmark suite was per-stage host materialization).
+        Interim stage-output columns and their ml attrs are reproduced
+        exactly; falls back to the generic path whenever the shape doesn't
+        fit. Mirrors Spark's lazy whole-stage codegen philosophy
+        (`SML/ML 00b - Spark Review.py:45`) on the host side."""
+        import os as _os
+        debug = _os.environ.get("SML_FUSED_DEBUG") == "1"
+        try:
+            if not hasattr(df, "toPandas") or getattr(df, "isStreaming", False):
+                return None
+            plan = self._fast_plan()
+            if plan is None:
+                return None
+            feat, scorer, assembler, tail = plan
+        except Exception:
+            if debug:
+                raise
+            return None
+        from ..frame.dataframe import DataFrame as _DF, _split_rows
+        from .linalg import vector_series
+        out_col = assembler.getOrDefault("outputCol")
+        parent = df
+
+        def compute():
+            import pandas as pd
+            raw = parent.toPandas()
+            n_parts = len(parent._materialize())
+            X, keep, cols = feat.transform_with_columns(raw)
+            if cols is None:
+                return None  # un-recoverable interim: caller falls back
+            base = raw if keep is None else \
+                raw[keep].reset_index(drop=True)
+            out = base.copy(deep=False)
+            for name, val in cols.items():
+                if isinstance(val, tuple) and val[0] == "block":
+                    out[name] = vector_series(val[1], index=out.index,
+                                              sparse=True, na=val[2])
+                else:
+                    out[name] = pd.Series(val, index=out.index)
+            out[out_col] = vector_series(X, index=out.index)
+            if scorer is not None:
+                out[tail.getOrDefault("predictionCol")] = pd.Series(
+                    np.asarray(scorer.score_block(X), dtype=np.float64),
+                    index=out.index)
+            return _split_rows(out, n_parts)
+
+        # run the pass EAGERLY so a mid-pass surprise (odd dtype, unseen
+        # interim shape) can still fall back to the generic path; consumers
+        # get a materialized frame either way
+        from ..utils.profiler import PROFILER
+        try:
+            with PROFILER.span("fused_transform",
+                               rows=None, stages=len(self.stages)):
+                parts = compute()
+        except Exception:
+            if debug:
+                raise
+            return None
+        if parts is None:
+            return None
+        res = _DF.from_partitions(parts, session=getattr(df, "_session", None))
+        res._ml_attrs = dict(df._ml_attrs)
+        res._ml_attrs.update(feat.interim_attrs())
+        res._ml_attrs[out_col] = feat.feature_attrs()
+        return res
 
     def copy(self, extra=None) -> "PipelineModel":
         that = super().copy(extra)

@@ -90,7 +90,7 @@ class _IndexSource(_Source):
         c[(c < 0) | ~notna] = np.nan
         return c
 
-    def resolve(self, pdf, drop_mask) -> np.ndarray:
+    def resolve(self, pdf, drop_mask, sink=None) -> np.ndarray:
         c = self.codes(pdf)
         missing = ~np.isfinite(c)
         if missing.any():
@@ -102,12 +102,14 @@ class _IndexSource(_Source):
                 drop_mask |= missing
             else:  # keep
                 c[missing] = float(len(self.labels))
+        if sink is not None:  # fused-transform interim capture (one pass)
+            sink[id(self)] = c
         return c
 
-    def write(self, pdf, out, lo, drop_mask=None):
+    def write(self, pdf, out, lo, drop_mask=None, sink=None):
         out[:, lo] = self.resolve(
             pdf, drop_mask if drop_mask is not None
-            else np.zeros(len(pdf), dtype=bool))
+            else np.zeros(len(pdf), dtype=bool), sink)
 
 
 class _OneHotSource(_Source):
@@ -117,11 +119,11 @@ class _OneHotSource(_Source):
         self.inner = inner  # _IndexSource or _NumericSource
         self.width = int(width)
 
-    def write(self, pdf, out, lo, drop_mask=None):
+    def write(self, pdf, out, lo, drop_mask=None, sink=None):
         if isinstance(self.inner, _IndexSource):
             idx = self.inner.resolve(
                 pdf, drop_mask if drop_mask is not None
-                else np.zeros(len(pdf), dtype=bool))
+                else np.zeros(len(pdf), dtype=bool), sink)
         else:
             idx = _numeric(pdf[self.inner.col])
             if self.inner.fill is not None:  # Imputer feeding the encoder
@@ -142,6 +144,10 @@ class CompiledFeaturizer:
         self.sources = sources
         self.handle_invalid = handle_invalid
         self.width = sum(s.width for s in sources)
+        # (name, source) for every prep-stage output column in stage order —
+        # the fused transform path rebuilds these interim columns from the
+        # one-pass results instead of running per-stage pandas chains
+        self.named_producers: List[tuple] = []
 
     @classmethod
     def from_stages(cls, stages, assembler) -> Optional["CompiledFeaturizer"]:
@@ -186,12 +192,15 @@ class CompiledFeaturizer:
         sources: List[_Source] = []
         for c in assembler.getOrDefault("inputCols"):
             sources.append(producers.get(c) or _NumericSource(c))
-        return cls(sources, invalid)
+        out = cls(sources, invalid)
+        out.named_producers = list(producers.items())
+        return out
 
-    def transform_with_mask(self, pdf: pd.DataFrame):
+    def transform_with_mask(self, pdf: pd.DataFrame, sink=None):
         """(X, keep): the assembled block and the row-keep mask (None when
         no StringIndexer 'skip' drops happened) — callers that pair X with
-        labels from the RAW frame must apply the same mask."""
+        labels from the RAW frame must apply the same mask. `sink` captures
+        resolved indexer codes by id(source) for the fused transform."""
         out = np.empty((len(pdf), self.width), dtype=np.float32)
         drop = np.zeros(len(pdf), dtype=bool)
         # contiguous runs of plain numeric sources extract as ONE pandas
@@ -227,7 +236,7 @@ class CompiledFeaturizer:
             if id(s) in done:
                 pass
             elif isinstance(s, (_IndexSource, _OneHotSource)):
-                s.write(pdf, out, lo, drop)
+                s.write(pdf, out, lo, drop, sink)
             else:
                 s.write(pdf, out, lo)
             lo += s.width
@@ -243,6 +252,72 @@ class CompiledFeaturizer:
 
     def __call__(self, pdf: pd.DataFrame) -> np.ndarray:
         return self.transform_with_mask(pdf)[0]
+
+    def _slot_map(self) -> dict:
+        """assembler input position by source id: id(source) → (lo, width)."""
+        m, lo = {}, 0
+        for s in self.sources:
+            m[id(s)] = (lo, s.width)
+            lo += s.width
+        return m
+
+    def feature_attrs(self) -> dict:
+        """The `_ml_attrs` entry the generic VectorAssembler transform would
+        publish for its output column: categorical slot cardinalities (tree
+        learners' maxBins semantics) + total width."""
+        slots, lo = {}, 0
+        for s in self.sources:
+            if isinstance(s, _IndexSource):
+                extra = 1 if s.invalid == "keep" else 0
+                slots[lo] = len(s.labels) + extra
+            lo += s.width
+        return {"slots": slots, "numFeatures": self.width}
+
+    def interim_attrs(self) -> dict:
+        """Per-interim-column `_ml_attrs` matching the generic stage
+        transforms (indexer 'categorical', OHE 'numFeatures')."""
+        attrs = {}
+        for name, src in self.named_producers:
+            if isinstance(src, _IndexSource):
+                extra = 1 if src.invalid == "keep" else 0
+                attrs[name] = {"categorical": len(src.labels) + extra}
+            elif isinstance(src, _OneHotSource):
+                attrs[name] = {"numFeatures": src.width}
+        return attrs
+
+    def transform_with_columns(self, pdf: pd.DataFrame):
+        """One-pass fused TRANSFORM: (X, keep, cols) where `cols` maps every
+        prep-stage output column name to its value — a 1-D float array for
+        scalar outputs or a `("block", arr2d, na_mask)` tuple for one-hot
+        vector outputs. Everything is recovered from the single columnar
+        pass: assembler-input producers read back their X slice, indexer
+        codes consumed only by an encoder come from the resolve sink."""
+        sink: dict = {}
+        X, keep = self.transform_with_mask(pdf, sink)
+        slot = self._slot_map()
+        cols = {}
+        for name, src in self.named_producers:
+            sid = id(src)
+            if sid in slot:
+                lo, w = slot[sid]
+                val = X[:, lo] if w == 1 else X[:, lo:lo + w]
+            elif sid in sink:
+                v = sink[sid]
+                val = v[keep] if keep is not None else v
+            elif isinstance(src, _NumericSource):
+                v = _numeric(pdf[src.col])
+                if src.fill is not None:
+                    v = np.where(np.isfinite(v), v, src.fill)
+                val = v[keep] if keep is not None else v
+            else:  # an un-assembled encoder output: not worth a second pass
+                return X, keep, None
+            if isinstance(src, _OneHotSource) and np.ndim(val) == 2:
+                na = ~np.isfinite(val).all(axis=1)
+                cols[name] = ("block", val, na)
+            else:
+                cols[name] = np.asarray(val, dtype=np.float64).reshape(-1) \
+                    if np.ndim(val) == 1 else val
+        return X, keep, cols
 
 
 def try_fast_fit(stages, raw_pdf, make_frame):

@@ -139,6 +139,10 @@ class Params:
         for k, v in kwargs.items():
             if v is not None:
                 self._paramMap[self.getParam(k)] = v
+        # monotonically counts param mutations: compiled-plan caches (the
+        # fused pipeline transform) fold this into their tokens so a
+        # post-fit setter call invalidates them
+        self._param_version = getattr(self, "_param_version", 0) + 1
         return self
 
     def _setDefault(self, **kwargs) -> "Params":

@@ -118,8 +118,16 @@ def submeshes(k: int, mesh: Optional[Mesh] = None) -> list:
         start = 0
         for g in range(groups):
             size = per + (1 if g < extra else 0)
-            out.append(Mesh(np.asarray(devices[start:start + size]),
-                            (DATA_AXIS,)))
+            if size == n and mesh.shape.get(DATA_AXIS) == n:
+                # a "submesh" spanning the whole 1-D parent IS the parent:
+                # returning the same object lets trial fits hit the parent
+                # mesh's program caches instead of re-loading + re-warming
+                # every executable on an identical-but-distinct Mesh (the
+                # dominant warmup cost on a tunneled single chip)
+                out.append(mesh)
+            else:
+                out.append(Mesh(np.asarray(devices[start:start + size]),
+                                (DATA_AXIS,)))
             start += size
         _submesh_cache[key] = out
     cached = _submesh_cache[key]

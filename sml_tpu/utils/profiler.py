@@ -27,12 +27,14 @@ class Span:
     wall_s: float
     rows: Optional[int] = None
     meta: Dict[str, object] = field(default_factory=dict)
+    self_s: float = 0.0  # wall minus enclosed child spans (same thread)
 
 
 class Profiler:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._spans: List[Span] = []
+        self._tls = threading.local()
 
     @property
     def enabled(self) -> bool:
@@ -40,16 +42,29 @@ class Profiler:
 
     @contextlib.contextmanager
     def span(self, name: str, rows: Optional[int] = None, **meta) -> Iterator[None]:
+        """Nested spans subtract from the parent's SELF time, so a
+        `materialize` that waits on a device program reports only its own
+        host-side cost — totals in the report stay attributable."""
         if not self.enabled:
             yield
             return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        child_acc = [0.0]
+        stack.append(child_acc)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            stack.pop()
+            if stack:
+                stack[-1][0] += dt
             with self._lock:
-                self._spans.append(Span(name, dt, rows, meta))
+                self._spans.append(
+                    Span(name, dt, rows, meta,
+                         self_s=max(0.0, dt - child_acc[0])))
 
     def spans(self) -> List[Span]:
         with self._lock:
@@ -60,25 +75,30 @@ class Profiler:
             self._spans.clear()
 
     def report(self) -> str:
-        """Spark-UI-style aggregate table: op, calls, total s, rows, and
+        """Spark-UI-style aggregate table: op, calls, total wall, SELF time
+        (wall minus enclosed spans — the op's attributable cost), rows, and
         the dispatch route (host / device / mixed) where recorded."""
         agg: Dict[str, List[float]] = {}
+        selfs: Dict[str, float] = {}
         rows_agg: Dict[str, int] = {}
         routes: Dict[str, set] = {}
         for s in self.spans():
             agg.setdefault(s.name, []).append(s.wall_s)
+            selfs[s.name] = selfs.get(s.name, 0.0) + s.self_s
             if s.rows:
                 rows_agg[s.name] = rows_agg.get(s.name, 0) + s.rows
             r = s.meta.get("route")
             if r:
                 routes.setdefault(s.name, set()).add(r)
-        lines = [f"{'op':<34}{'calls':>7}{'total_s':>11}{'rows':>13}{'route':>9}"]
-        for name in sorted(agg, key=lambda n: -sum(agg[n])):
+        lines = [f"{'op':<34}{'calls':>7}{'total_s':>10}{'self_s':>10}"
+                 f"{'rows':>13}{'route':>9}"]
+        for name in sorted(agg, key=lambda n: -selfs.get(n, 0.0)):
             ts = agg[name]
             rset = routes.get(name, set())
             route = (rset.pop() if len(rset) == 1
                      else ("mixed" if rset else "-"))
-            lines.append(f"{name:<34}{len(ts):>7}{sum(ts):>11.4f}"
+            lines.append(f"{name:<34}{len(ts):>7}{sum(ts):>10.4f}"
+                         f"{selfs.get(name, 0.0):>10.4f}"
                          f"{rows_agg.get(name, 0):>13}{route:>9}")
         return "\n".join(lines)
 
