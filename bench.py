@@ -37,7 +37,8 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 import numpy as np
 
 N_ROWS = 1_000_000
-LEGS_VERSION = 4  # bump when leg definitions change (invalidates the cache)
+N_RATINGS = 1_000_000  # MovieLens-1M-scale ALS workload (`MLE 01:18`)
+LEGS_VERSION = 5  # bump when leg definitions change (invalidates the cache)
 HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_CACHE = os.path.join(HERE, "baseline_host.json")
 
@@ -53,12 +54,65 @@ def build_dataset(n):
     return get_session().createDataFrame(pdf), pdf
 
 
+def build_ratings(n):
+    """MovieLens-1M-shaped ratings at the real set's entity dims
+    (~6040 users x ~3700 movies, `SML/ML Electives/MLE 01:18`)."""
+    from sml_tpu.courseware import make_movielens_dataset
+    from sml_tpu.frame.session import get_session
+    pdf = make_movielens_dataset(n_users=6040, n_items=3700,
+                                 n_ratings=n, seed=42)
+    return get_session().createDataFrame(pdf), pdf
+
+
 CAT_COLS = ["neighbourhood_cleansed", "room_type", "property_type"]
 NUM_COLS = ["accommodates", "bathrooms", "bedrooms", "beds",
             "minimum_nights", "number_of_reviews", "review_scores_rating"]
 
 
-def run_suite(df, n_rows):
+def run_electives(ratings_df, train, timings, flops):
+    """MLE 01 (block-parallel ALS on MovieLens-1M scale) and MLE 02
+    (fused-Lloyd KMeans) — the electives' flagship distributed fits
+    (`MLE 01:159-201` "CV takes a few minutes, refit ~1 minute";
+    `MLE 02:38-57`)."""
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.clustering import KMeans
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import Imputer, VectorAssembler
+    from sml_tpu.ml.recommendation import ALS
+
+    rank, als_iters = 8, 10
+    t0 = time.perf_counter()
+    als_train, als_test = ratings_df.randomSplit([0.8, 0.2], seed=42)
+    als = ALS(userCol="userId", itemCol="movieId", ratingCol="rating",
+              rank=rank, maxIter=als_iters, regParam=0.1, seed=42,
+              coldStartStrategy="drop")
+    als_model = als.fit(als_train)
+    rmse_als = RegressionEvaluator(labelCol="rating").evaluate(
+        als_model.transform(als_test))
+    timings["mle01_als"] = time.perf_counter() - t0
+    n_tr = als_train.count()  # the fit's actual nnz (80% split)
+    flops["mle01_als"] = 2.0 * als_iters * (n_tr * rank * rank
+                                            + (6040 + 3700) * rank ** 3)
+
+    k, km_iters = 8, 20
+    # feature prep happens OUTSIDE the timed region on both sides: the
+    # host baseline times only sklearn's KMeans.fit on a prepared matrix
+    imp = [c + "_imp" for c in NUM_COLS]
+    km_feats = Pipeline(stages=[
+        Imputer(strategy="median", inputCols=NUM_COLS, outputCols=imp),
+        VectorAssembler(inputCols=imp, outputCol="features"),
+    ]).fit(train).transform(train)
+    km_feats.cache()
+    t0 = time.perf_counter()
+    km_model = KMeans(k=k, maxIter=km_iters, seed=221).fit(km_feats)
+    centers = km_model.clusterCenters()
+    timings["mle02_kmeans"] = time.perf_counter() - t0
+    n_train = train.count()
+    flops["mle02_kmeans"] = 3.0 * km_iters * n_train * len(NUM_COLS) * k
+    return {"rmse_als": rmse_als, "kmeans_k": float(len(centers))}
+
+
+def run_suite(df, n_rows, ratings_df=None):
     from sml_tpu.ml import DeviceScorer, Pipeline
     from sml_tpu.ml.evaluation import RegressionEvaluator
     from sml_tpu.ml.feature import (Imputer, OneHotEncoder, StringIndexer,
@@ -175,6 +229,11 @@ def run_suite(df, n_rows):
     flops["ml11_xgb"] = 2.0 * 40 * 6 * n_train * 10 * 64
 
     # ---- ML 12: batch inference through the device scorer ---------------
+    # the lesson's own tuning knob (`ML 12:90,121`): larger Arrow batches
+    # amortize per-batch dispatch — the factorized scorer streams 50k rows
+    from sml_tpu.conf import GLOBAL_CONF as _CONF
+    _old_bs = _CONF.get("spark.sql.execution.arrow.maxRecordsPerBatch")
+    _CONF.set("spark.sql.execution.arrow.maxRecordsPerBatch", 50000)
     t0 = time.perf_counter()
     scorer = DeviceScorer(lr_model)
 
@@ -185,6 +244,7 @@ def run_suite(df, n_rows):
 
     n_scored = test.mapInPandas(predict_batches, "prediction double").count()
     timings["ml12_mapinpandas"] = time.perf_counter() - t0
+    _CONF.set("spark.sql.execution.arrow.maxRecordsPerBatch", _old_bs)
     flops["ml12_mapinpandas"] = 2.0 * n_scored * d_lr
 
     # ---- ML 13: per-group training fan-out ------------------------------
@@ -212,11 +272,52 @@ def run_suite(df, n_rows):
     metrics = {"rmse_lr": rmse_lr, "rmse_dt": rmse_dt, "rmse_rf": rmse_rf,
                "rmse_xgb": rmse_xgb, "cv_best_rmse": cv_best,
                "rows_scored": n_scored, "groups": n_groups}
+    if ratings_df is not None:
+        metrics.update(run_electives(ratings_df, train, timings, flops))
     return timings, metrics, flops
 
 
+def _host_als(ratings, rank, iters, reg, seed=42):
+    """Efficient single-node numpy ALS (the honest host anchor — sklearn
+    has no ALS): per-side normal equations accumulated with sorted
+    reduceat segment sums, batched np.linalg.solve, ALS-WR reg."""
+    users = ratings["userId"].to_numpy(np.int64)
+    items = ratings["movieId"].to_numpy(np.int64)
+    r = ratings["rating"].to_numpy(np.float32)
+    n_u, n_i = users.max() + 1, items.max() + 1
+    rng = np.random.default_rng(seed)
+    U = rng.normal(0, 0.1, (n_u, rank)).astype(np.float32)
+    V = rng.normal(0, 0.1, (n_i, rank)).astype(np.float32)
+
+    def half(ids, n_out, other_rows, rr):
+        order = np.argsort(ids, kind="stable")
+        ids_s = ids[order]
+        F = other_rows[order]
+        rs = rr[order]
+        starts = np.minimum(np.searchsorted(ids_s, np.arange(n_out)),
+                            max(len(F) - 1, 0))
+        outer = (F[:, :, None] * F[:, None, :]).reshape(len(F), -1)
+        A = np.add.reduceat(outer, starts, axis=0).reshape(n_out, rank, rank)
+        b = np.add.reduceat(F * rs[:, None], starts, axis=0)
+        cnt = np.bincount(ids_s, minlength=n_out).astype(np.float32)
+        # reduceat yields a bogus single element for empty segments: zero
+        empty = cnt == 0
+        A[empty] = 0.0
+        b[empty] = 0.0
+        lam = reg * np.maximum(cnt, 1.0)
+        A = A + lam[:, None, None] * np.eye(rank, dtype=np.float32)[None]
+        sol = np.linalg.solve(A, b[:, :, None])[:, :, 0]
+        sol[empty] = 0.0
+        return sol.astype(np.float32)
+
+    for _ in range(iters):
+        U = half(users, n_u, V[items], r)
+        V = half(items, n_i, U[users], r)
+    return U, V
+
+
 # ---------------------------------------------------------------- host baseline
-def run_host_baseline(pdf):
+def run_host_baseline(pdf, ratings_pdf=None):
     """The SAME legs executed the single-node pandas/sklearn way — the
     measured anchor for vs_baseline (replaces r1's invented constant)."""
     import pandas as pd
@@ -304,10 +405,29 @@ def run_host_baseline(pdf):
             float(np.mean((gm.predict(g[["accommodates", "bedrooms"]])
                            - g["price"]) ** 2))
     timings["ml13_applyinpandas"] = time.perf_counter() - t0
+
+    if ratings_pdf is not None:
+        from sklearn.cluster import KMeans as SkKMeans
+        rng = np.random.RandomState(42)
+        tr_mask = rng.rand(len(ratings_pdf)) < 0.8
+        t0 = time.perf_counter()
+        U, V = _host_als(ratings_pdf[tr_mask], rank=8, iters=10, reg=0.1)
+        te = ratings_pdf[~tr_mask]
+        pred = np.sum(U[te["userId"].to_numpy(np.int64)]
+                      * V[te["movieId"].to_numpy(np.int64)], axis=1)
+        float(np.sqrt(np.mean((pred - te["rating"].to_numpy(np.float64))
+                              ** 2)))
+        timings["mle01_als"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        Xk = train[NUM_COLS].to_numpy(np.float64)
+        SkKMeans(n_clusters=8, init="k-means++", n_init=1, max_iter=20,
+                 random_state=221).fit(Xk)
+        timings["mle02_kmeans"] = time.perf_counter() - t0
     return timings
 
 
-def get_host_baseline(pdf):
+def get_host_baseline(pdf, ratings_pdf=None):
     if os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:
             cached = json.load(f)
@@ -316,7 +436,7 @@ def get_host_baseline(pdf):
             return cached["timings"]
     print("measuring single-node host baseline (cached afterwards)...",
           file=sys.stderr)
-    timings = run_host_baseline(pdf)
+    timings = run_host_baseline(pdf, ratings_pdf)
     with open(BASELINE_CACHE, "w") as f:
         json.dump({"n_rows": N_ROWS, "legs_version": LEGS_VERSION,
                    "timings": timings,
@@ -332,7 +452,9 @@ def main():
     print(f"devices: {jax.devices()}", file=sys.stderr)
     df, pdf = build_dataset(N_ROWS)
     df.cache()
-    base = get_host_baseline(pdf)
+    ratings_df, ratings_pdf = build_ratings(N_RATINGS)
+    ratings_df.cache()
+    base = get_host_baseline(pdf, ratings_pdf)
 
     from sml_tpu.conf import GLOBAL_CONF
     GLOBAL_CONF.set("sml.profiler.enabled", True)
@@ -344,17 +466,17 @@ def main():
     # compile_seconds — compile economics are part of the story, not
     # discarded (SURVEY §7 hard-part #6).
     t0 = time.perf_counter()
-    run_suite(df, N_ROWS)
+    run_suite(df, N_ROWS, ratings_df)
     pass1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    run_suite(df, N_ROWS)
+    run_suite(df, N_ROWS, ratings_df)
     pass2 = time.perf_counter() - t0
     warmup_secs = pass1 + pass2
 
     from sml_tpu.utils.profiler import PROFILER
     PROFILER.reset()
     t0 = time.perf_counter()
-    timings, metrics, flops = run_suite(df, N_ROWS)
+    timings, metrics, flops = run_suite(df, N_ROWS, ratings_df)
     wall = time.perf_counter() - t0
     base_wall = sum(base.get(k, 0.0) for k in timings)
 
@@ -398,8 +520,8 @@ def main():
     print(PROFILER.report(), file=sys.stderr)
 
     print(json.dumps({
-        "metric": "ml02-ml13 suite wall-clock (1M-row SF-Airbnb-class, "
-                  "all 5 BASELINE configs, fit+predict)",
+        "metric": "ml02-ml13 + mle01/mle02 suite wall-clock (1M-row "
+                  "SF-Airbnb-class + MovieLens-1M-scale ALS, fit+predict)",
         "value": round(wall, 3),
         "unit": "seconds",
         "vs_baseline": round(base_wall / wall, 3),

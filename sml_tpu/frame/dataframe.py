@@ -793,9 +793,18 @@ class _RDDShim:
 
 
 def _hash_repartition(pdf: pd.DataFrame, keys: List[str], num: int) -> Partitions:
-    """Murmur3 hash-partition rows by key columns (shuffle placement)."""
+    """Murmur3 hash-partition rows by key columns (shuffle placement).
+    Records the post-shuffle partition skew (max/mean rows) — the MLE 05
+    debugging taxonomy's skew signal (`MLE 05:24-29`)."""
     if len(pdf) == 0:
         return [pdf.reset_index(drop=True)]
     hashes = hash_columns([pdf[k] for k in keys], n=len(pdf))
     ids = hash_partition_ids(hashes, num)
-    return [pdf[ids == i].reset_index(drop=True) for i in range(num)]
+    parts = [pdf[ids == i].reset_index(drop=True) for i in range(num)]
+    sizes = np.array([len(p) for p in parts], dtype=float)
+    if sizes.sum() > 0:
+        PROFILER.count("shuffle.rows", float(sizes.sum()))
+        with PROFILER.span("shuffle.partition", rows=int(sizes.sum()),
+                           skew=float(sizes.max() / max(sizes.mean(), 1.0))):
+            pass
+    return parts

@@ -179,6 +179,7 @@ def _cache_put(key, value):
 
 def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
     """device_put a row-sharded array through the content cache."""
+    from ..utils.profiler import PROFILER
     mesh = meshlib.get_mesh()
     n_dev = mesh.shape[meshlib.DATA_AXIS]
     a = _normalize(a)
@@ -190,6 +191,11 @@ def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
             if pad_to_multiple else a)
         hit = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
         _cache_put(key, hit)
+        PROFILER.count("staging.cache_miss")
+        PROFILER.count("staging.h2d_bytes", padded.nbytes)
+    else:
+        PROFILER.count("staging.cache_hit")
+        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
     return hit
 
 
@@ -372,4 +378,7 @@ def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True,
             # ONE batched device→host transfer for the whole output tree:
             # per-leaf np.asarray pays the tunnel's fixed D2H latency once
             # PER ARRAY, which dominated r1's per-fit wall-clock
-            return jax.device_get(out)
+            host = jax.device_get(out)
+            PROFILER.count("staging.d2h_bytes", sum(
+                np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(host)))
+            return host

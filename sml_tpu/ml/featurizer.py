@@ -32,6 +32,21 @@ def _numeric(col) -> np.ndarray:
                                                         na_value=np.nan)
 
 
+def extract_numeric_block(pdf: pd.DataFrame, cols: List[str],
+                          fills: np.ndarray) -> np.ndarray:
+    """(n, k) float64 block of `cols` with per-column NaN fills — ONE
+    pandas extraction with a coercion fallback for non-numeric storage.
+    Shared by the fused featurizer pass and the factorized scorer so their
+    coercion semantics can never diverge."""
+    try:
+        block = pdf[cols].to_numpy(np.float64, na_value=np.nan)
+    except (TypeError, ValueError):  # non-numeric storage: coerce
+        block = pdf[cols].apply(
+            lambda c: pd.to_numeric(c, errors="coerce")).to_numpy(
+            np.float64, na_value=np.nan)
+    return np.where(np.isfinite(block), block, fills[None, :])
+
+
 class _Source:
     """One resolved input column: writes its slot(s) of the output block."""
 
@@ -222,14 +237,8 @@ class CompiledFeaturizer:
             cols = [s.col for _, s in run]
             fills = np.asarray([np.nan if s.fill is None else s.fill
                                 for _, s in run])
-            try:
-                block = pdf[cols].to_numpy(np.float64, na_value=np.nan)
-            except (TypeError, ValueError):  # non-numeric storage: coerce
-                block = pdf[cols].apply(
-                    lambda c: pd.to_numeric(c, errors="coerce")).to_numpy(
-                    np.float64, na_value=np.nan)
-            block = np.where(np.isfinite(block), block, fills[None, :])
-            out[:, run[0][0]:run[0][0] + len(run)] = block
+            out[:, run[0][0]:run[0][0] + len(run)] = \
+                extract_numeric_block(pdf, cols, fills)
             done.update(id(s) for _, s in run)
         lo = 0
         for s in self.sources:

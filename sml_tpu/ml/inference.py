@@ -132,6 +132,13 @@ class DeviceScorer:
                     last.getOrDefault("outputCol") == self.featuresCol:
                 self._featurizer = CompiledFeaturizer.from_stages(
                     self._stages[:-1], last)
+        # linear model over one-hot slots is algebraically an EMBEDDING SUM:
+        # w·onehot(idx) == w_slice[idx]. The factorized scorer skips
+        # materializing the (n, d) one-hot block entirely — the ML 12
+        # serving path's cost was almost all block assembly
+        self._factorized = None
+        if self._featurizer is not None and self._kind == "linear":
+            self._factorized = self._build_factorized()
 
     @staticmethod
     def _compile_target(model):
@@ -210,6 +217,77 @@ class DeviceScorer:
                    jnp.asarray(w, dtype=jnp.float32))
         return out, n, finalize
 
+    def _build_factorized(self):
+        """(scalar_sources, scalar_weights, embeds): weight slices aligned
+        to the featurizer's slot layout. Returns None when any source shape
+        is unsupported."""
+        from .featurizer import _IndexSource, _NumericSource, _OneHotSource
+        w = np.asarray(self._params[0], dtype=np.float64)
+        if w.ndim != 1 or w.shape[0] != self._featurizer.width:
+            return None
+        scalars, embeds = [], []
+        lo = 0
+        for s in self._featurizer.sources:
+            if isinstance(s, _OneHotSource):
+                embeds.append((s.inner, w[lo:lo + s.width].copy()))
+            elif isinstance(s, (_NumericSource, _IndexSource)):
+                scalars.append((s, float(w[lo])))
+            else:
+                return None
+            lo += s.width
+        return scalars, embeds
+
+    def _score_factorized(self, pdf) -> np.ndarray:
+        """Linear predict without the one-hot block: numeric dot + one
+        embedding-table lookup per encoded column. Exactly the X·w result
+        (NaN propagation, handleInvalid drops/keep-overflow included)."""
+        import pandas as pd
+        from .featurizer import (_IndexSource, _NumericSource,
+                                 extract_numeric_block)
+        scalars, embeds = self._factorized
+        _, b, logistic = self._params
+        n = len(pdf)
+        drop = np.zeros(n, dtype=bool)
+        acc = np.full(n, float(b), dtype=np.float64)
+        # numeric block in ONE pandas extraction (dominant scalar cost)
+        num = [(s, wi) for s, wi in scalars if type(s) is _NumericSource]
+        if num:
+            cols = [s.col for s, _ in num]
+            fills = np.asarray([np.nan if s.fill is None else s.fill
+                                for s, _ in num])
+            block = extract_numeric_block(pdf, cols, fills)
+            # f32 quantization parity with the block path (X is float32)
+            acc += block.astype(np.float32).astype(np.float64) \
+                @ np.asarray([wi for _, wi in num])
+        for s, wi in scalars:
+            if isinstance(s, _IndexSource):
+                acc += wi * s.resolve(pdf, drop)
+        for inner, table in embeds:
+            if isinstance(inner, _IndexSource):
+                idx = inner.resolve(pdf, drop)
+            else:
+                idx = np.asarray(pd.to_numeric(pdf[inner.col],
+                                               errors="coerce"), np.float64)
+                if inner.fill is not None:
+                    idx = np.where(np.isfinite(idx), idx, inner.fill)
+            na = ~np.isfinite(idx)
+            ok = ~na & (idx >= 0) & (idx < len(table))
+            contrib = np.zeros(n, dtype=np.float64)
+            oki = np.nonzero(ok)[0]
+            contrib[oki] = table[idx[oki].astype(np.intp)]
+            contrib[na] = np.nan  # NaN one-hot row → NaN prediction
+            acc += contrib
+        if self._featurizer.handle_invalid == "error" \
+                and not np.isfinite(acc[~drop]).all():
+            raise ValueError(
+                "VectorAssembler found NaN/null in assembled features; set "
+                "handleInvalid='skip' or impute first")
+        if drop.any():
+            acc = acc[~drop]
+        if logistic:
+            acc = 1.0 / (1.0 + np.exp(-acc))
+        return acc
+
     def score_block(self, X: np.ndarray) -> np.ndarray:
         """Predict from a raw (n, d) feature block."""
         out, n, finalize = self._dispatch(X)
@@ -217,7 +295,13 @@ class DeviceScorer:
 
     def __call__(self, pdf) -> np.ndarray:
         """Predict from a host pandas batch: run feature stages, extract
-        the columnar feature block, score on-device."""
+        the columnar feature block, score on-device (or factorized on host
+        for linear models — see _score_factorized)."""
+        if self._factorized is not None and not isinstance(pdf, np.ndarray):
+            try:
+                return self._score_factorized(pdf)
+            except KeyError:
+                self._factorized = None  # batch missing a raw column
         return self.score_block(self._prep(pdf))
 
     def _prep(self, pdf) -> np.ndarray:
@@ -252,6 +336,29 @@ class DeviceScorer:
         H2D staging, device compute, and D2H transfers all overlap."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
+        if self._factorized is not None:
+            # factorized linear scoring is pure host numpy/pandas work:
+            # overlap batches on worker threads with BOUNDED lookahead —
+            # Executor.map would drain the whole source iterator eagerly
+            it = iter(batches)
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                window: deque = deque()
+
+                def pull() -> bool:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return False
+                    window.append(ex.submit(self.__call__, b))
+                    return True
+
+                for _ in range(4):
+                    pull()
+                while window:
+                    out = window.popleft().result()
+                    pull()
+                    yield out
+            return
         pending: deque = deque()
 
         def drain_one():

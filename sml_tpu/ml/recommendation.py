@@ -3,16 +3,16 @@
 The reference's `ALS(userCol, itemCol, ratingCol, rank, maxIter,
 coldStartStrategy)` trains MovieLens 1M (`SML/ML Electives/MLE 01 -
 Collaborative Filtering Lab.py:159-201`). Spark's implementation blocks
-users/items across executors and shuffles factor blocks; here each half-step
-is ONE jitted shard_map program over rating shards:
+users/items across executors and shuffles factor blocks; here the WHOLE
+alternating fit is ONE jitted shard_map program (`fori_loop` over
+iterations), each half-step inside it:
 
     per chip:  segment-sum of (f_i ⊗ f_i, r·f_i) by user  → (U, r, r), (U, r)
     psum       over ICI (the factor-block exchange)
-    vmapped    batched Cholesky solve of all U normal systems on-device
+    batched    solve of all U normal systems on-device
 
-with ALS-WR regularization (λ·n_u, Spark's scheme). Ratings stay sharded in
-HBM for the whole fit; only the (entities × rank) factor matrices replicate.
-"""
+with ALS-WR regularization (λ·n_u, Spark's scheme). Ratings AND factors stay
+in HBM for the entire fit: one dispatch, one packed factor download."""
 
 from __future__ import annotations
 
@@ -33,14 +33,18 @@ from functools import lru_cache
 
 
 @lru_cache(maxsize=64)
-def _half_step_program(n_out: int, rank: int, reg: float):
-    """Solve factors for one side given the other side's factors."""
+def _als_fit_program(n_users: int, n_items: int, rank: int, reg: float,
+                     max_iter: int, nonneg: bool):
+    """The WHOLE alternating fit as one XLA program: `fori_loop` over
+    iterations, both half-steps inside, factors living on-device for the
+    entire fit. One dispatch per fit instead of 2·maxIter — the per-launch
+    tunnel latency disappears, and the CPU test mesh never has multiple
+    collective executables racing one rendezvous (r4: 20 async half-step
+    launches could deadlock XLA:CPU's cross-module all-reduce)."""
 
-    def program(ids, ratings, mask, other_factors_rows):
-        # ids: (n,) int32 target-entity id per rating (row-sharded)
-        # other_factors_rows: (n, rank) factor of the *other* entity per rating
-        f = other_factors_rows * mask[:, None]
-        outer = f[:, :, None] * other_factors_rows[:, None, :]   # (n, r, r)
+    def half(ids, ratings, mask, other_rows, n_out):
+        f = other_rows * mask[:, None]
+        outer = f[:, :, None] * other_rows[:, None, :]
         A = jax.ops.segment_sum(outer, ids, num_segments=n_out)
         b = jax.ops.segment_sum(f * ratings[:, None], ids, num_segments=n_out)
         cnt = jax.ops.segment_sum(mask, ids, num_segments=n_out)
@@ -50,7 +54,17 @@ def _half_step_program(n_out: int, rank: int, reg: float):
         lam = reg * jnp.maximum(cnt, 1.0)
         A = A + lam[:, None, None] * jnp.eye(rank, dtype=A.dtype)[None]
         sol = jnp.linalg.solve(A, b[:, :, None])[:, :, 0]
-        return jnp.where(cnt[:, None] > 0, sol, 0.0)
+        sol = jnp.where(cnt[:, None] > 0, sol, 0.0)
+        return jnp.maximum(sol, 0.0) if nonneg else sol
+
+    def program(u_ids, i_ids, ratings, mask, uf0, if0):
+        def body(_, carry):
+            uf, itf = carry
+            uf = half(u_ids, ratings, mask, itf[i_ids], n_users)
+            itf = half(i_ids, ratings, mask, uf[u_ids], n_items)
+            return uf, itf
+
+        return jax.lax.fori_loop(0, max_iter, body, (uf0, if0))
 
     return program
 
@@ -116,30 +130,24 @@ class ALS(Estimator):
             flops=2.0 * max_iter * (len(ratings) * rank * rank
                                     + (U + I) * rank ** 3),
             kind="blas")
+        nonneg = bool(self.getOrDefault("nonnegative"))
+        from ..utils.profiler import PROFILER
+        from ._staging import cached_data_parallel
         with routed_for(_hint, u32, i32, ratings):
             u_dev, i_dev, r_dev, mask, _ = stage_sharded(u32, i32, ratings)
 
-            uf = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
-            itf = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
+            uf0 = (rng.standard_normal((U, rank)) * 0.1).astype(np.float32)
+            if0 = (rng.standard_normal((I, rank)) * 0.1).astype(np.float32)
 
-            from ._staging import cached_data_parallel
-            solve_users = cached_data_parallel(_half_step_program(U, rank, reg))
-            solve_items = cached_data_parallel(_half_step_program(I, rank, reg))
-
-            @jax.jit
-            def gather(factors, idx):
-                return factors[idx]
-
-            nonneg = bool(self.getOrDefault("nonnegative"))
-            for _ in range(max_iter):
-                uf = solve_users(u_dev, r_dev, mask, gather(itf, i_dev))
-                if nonneg:
-                    uf = jnp.maximum(uf, 0.0)
-                itf = solve_items(i_dev, r_dev, mask, gather(uf, u_dev))
-                if nonneg:
-                    itf = jnp.maximum(itf, 0.0)
-
-            uf_h, itf_h = jax.device_get((uf, itf))  # one batched transfer
+            fit = cached_data_parallel(
+                _als_fit_program(U, I, rank, reg, max_iter, nonneg),
+                replicated_argnums=(4, 5))
+            with PROFILER.span("program.als_fit", rows=len(ratings),
+                               route="device"):
+                # ONE dispatch for the whole alternating fit; one batched
+                # device→host transfer for both factor matrices
+                uf_h, itf_h = jax.device_get(
+                    fit(u_dev, i_dev, r_dev, mask, uf0, if0))
         m = ALSModel(user_ids=u_ids, item_ids=i_ids,
                      user_factors=uf_h, item_factors=itf_h)
         m._inherit_params(self)

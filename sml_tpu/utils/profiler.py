@@ -34,7 +34,21 @@ class Profiler:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._spans: List[Span] = []
+        self._counters: Dict[str, float] = {}
         self._tls = threading.local()
+
+    def count(self, name: str, inc: float = 1.0) -> None:
+        """Engine counters (host↔device bytes, staging-cache hits, ...) —
+        the MLE 05-style observability the Spark UI/Ganglia provided
+        (`SML/ML Electives/MLE 05:24-36`)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + inc
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
 
     @property
     def enabled(self) -> bool:
@@ -73,6 +87,7 @@ class Profiler:
     def reset(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._counters.clear()
 
     def report(self) -> str:
         """Spark-UI-style aggregate table: op, calls, total wall, SELF time
@@ -82,6 +97,7 @@ class Profiler:
         selfs: Dict[str, float] = {}
         rows_agg: Dict[str, int] = {}
         routes: Dict[str, set] = {}
+        skews: Dict[str, float] = {}
         for s in self.spans():
             agg.setdefault(s.name, []).append(s.wall_s)
             selfs[s.name] = selfs.get(s.name, 0.0) + s.self_s
@@ -90,16 +106,29 @@ class Profiler:
             r = s.meta.get("route")
             if r:
                 routes.setdefault(s.name, set()).add(r)
+            sk = s.meta.get("skew")
+            if sk is not None:
+                skews[s.name] = max(skews.get(s.name, 0.0), float(sk))
         lines = [f"{'op':<34}{'calls':>7}{'total_s':>10}{'self_s':>10}"
-                 f"{'rows':>13}{'route':>9}"]
+                 f"{'rows':>13}{'route':>9}{'skew':>7}"]
         for name in sorted(agg, key=lambda n: -selfs.get(n, 0.0)):
             ts = agg[name]
             rset = routes.get(name, set())
             route = (rset.pop() if len(rset) == 1
                      else ("mixed" if rset else "-"))
+            sk = f"{skews[name]:.2f}" if name in skews else "-"
             lines.append(f"{name:<34}{len(ts):>7}{sum(ts):>10.4f}"
                          f"{selfs.get(name, 0.0):>10.4f}"
-                         f"{rows_agg.get(name, 0):>13}{route:>9}")
+                         f"{rows_agg.get(name, 0):>13}{route:>9}{sk:>7}")
+        counters = self.counters()
+        if counters:
+            lines.append("---- engine counters ----")
+            for k in sorted(counters):
+                v = counters[k]
+                if "_bytes" in k:
+                    lines.append(f"{k:<34}{v / 1e6:>14.1f} MB")
+                else:
+                    lines.append(f"{k:<34}{v:>14.0f}")
         return "\n".join(lines)
 
 

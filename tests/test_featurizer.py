@@ -99,7 +99,31 @@ def test_scorer_uses_featurizer_and_matches_transform():
     preds = scorer(batch)
     ref = model.transform(get_session().createDataFrame(batch)) \
         .toPandas()["prediction"].to_numpy()
-    np.testing.assert_allclose(preds, ref, rtol=1e-5)
+    # atol floor: the factorized scorer reassociates the dot (embedding
+    # sums instead of a one-hot matmul) — near-zero predictions differ at
+    # the f32-quantization level
+    np.testing.assert_allclose(preds, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_factorized_scorer_matches_block_path():
+    """The embedding-sum linear scorer must reproduce the one-hot block
+    path exactly: same predictions, same handleInvalid='skip' row drops,
+    NaN propagation for unseen-under-'keep' rows."""
+    pdf = _data()
+    df = get_session().createDataFrame(pdf)
+    for invalid in ("keep", "skip"):
+        model = _pipeline(invalid).fit(df)
+        scorer = DeviceScorer(model)
+        assert scorer._factorized is not None
+        batch = _data(seed=8)
+        batch.loc[batch.index[:5], "cat"] = "ZZ_UNSEEN"
+        fast = scorer(batch)
+        scorer2 = DeviceScorer(model)
+        scorer2._factorized = None  # force the block path
+        ref = scorer2(batch)
+        assert fast.shape == ref.shape
+        np.testing.assert_allclose(fast, ref, rtol=1e-5, atol=1e-7,
+                                   equal_nan=True)
 
 
 def test_featurizer_rejects_unknown_stage():

@@ -167,6 +167,68 @@ def test_arima_d2_fitted_and_forecast():
     assert np.all(np.diff(fc) > 0)
 
 
+def test_prophet_recovers_known_decomposition():
+    """Ground-truth golden for the MLE 04 decomposition (`MLE 04:79-176`):
+    a series built from a KNOWN piecewise-linear trend + weekly sinusoid
+    must come back apart into those exact components — a wrong trend /
+    seasonality split (the failure VERDICT r3 #8 worries about) cannot
+    pass. Analytic anchors beat library-value pins: neither prophet nor
+    statsmodels ships in this image, and the true components are exact."""
+    n = 400
+    ds = pd.date_range("2020-01-01", periods=n, freq="D")
+    t = np.arange(n, dtype=float)
+    # slope 0.20 until day 200, then 0.05; weekly amplitude 3
+    true_trend = 10 + 0.20 * np.minimum(t, 200) + 0.05 * np.maximum(t - 200, 0)
+    true_weekly = 3.0 * np.sin(2 * np.pi * t / 7.0)
+    rng = np.random.default_rng(7)
+    y = true_trend + true_weekly + rng.normal(0, 0.15, n)
+    m = Prophet(weekly_seasonality=True, yearly_seasonality=False,
+                daily_seasonality=False).fit(pd.DataFrame({"ds": ds, "y": y}))
+    fc = m.predict()
+    # trend component: matches the true piecewise line everywhere (a
+    # straight-line trend — the r3 failure where L1 froze all changepoint
+    # deltas — peaks at ~7.5 error; the healthy fit stays under ~1.7)
+    trend_err = np.abs(fc["trend"].to_numpy() - true_trend)
+    assert float(np.max(trend_err)) < 2.5, float(np.max(trend_err))
+    # weekly component: amplitude and phase of the true sinusoid
+    weekly = fc["weekly"].to_numpy()
+    assert float(np.sqrt(np.mean((weekly - true_weekly) ** 2))) < 0.35
+    amp = 0.5 * (weekly.max() - weekly.min())
+    assert amp == pytest.approx(3.0, abs=0.4)
+    # 30-day forecast continues the analytic function
+    fut = m.predict(m.make_future_dataframe(periods=30)).iloc[-30:]
+    tf = np.arange(n, n + 30, dtype=float)
+    truth = (10 + 0.20 * 200 + 0.05 * (tf - 200)
+             + 3.0 * np.sin(2 * np.pi * tf / 7.0))
+    assert float(np.max(np.abs(fut["yhat"].to_numpy() - truth))) < 2.0
+
+
+def test_holt_exact_on_noise_free_line():
+    """Exactness golden: on y = 3 + 2t with zero noise, Holt's level must
+    converge to the last observation and the trend to the true slope, so
+    forecasts continue the line to numerical precision."""
+    t = np.arange(100, dtype=float)
+    y = 3.0 + 2.0 * t
+    fc = Holt(y).fit().forecast(10)
+    expect = 3.0 + 2.0 * np.arange(100, 110)
+    np.testing.assert_allclose(fc, expect, atol=2e-2)
+    # SES on a constant series forecasts the constant
+    ses = SimpleExpSmoothing(np.full(50, 7.5)).fit()
+    np.testing.assert_allclose(ses.forecast(5), 7.5, atol=1e-6)
+
+
+def test_arima_ma_coefficient_recovery():
+    """MA(1) golden: theta is identified by CSS on enough data — a wrong
+    innovation recursion would bias it far outside the tolerance."""
+    rng = np.random.default_rng(9)
+    n = 3000
+    e = rng.normal(0, 1, n + 1)
+    y = e[1:] + 0.5 * e[:-1]
+    res = ARIMA(y, order=(0, 0, 1)).fit()
+    theta = float(res.params[-1])
+    assert theta == pytest.approx(0.5, abs=0.07), theta
+
+
 def test_arima_d1_fitted_matches_manual_integration():
     rng = np.random.default_rng(1)
     y = np.cumsum(1.0 + rng.normal(scale=0.3, size=80)) + 5
