@@ -104,7 +104,7 @@ class _EnsembleSpec:
         n = binned.shape[0]
         from ._staging import route_for_arrays
         hint = dispatch.WorkHint(
-            flops=4.0 * n * len(self.trees) * self.depth, kind="scatter",
+            flops=4.0 * n * len(self.trees) * self.depth, kind="traverse",
             out_bytes=4.0 * n)
         mesh, route = route_for_arrays(hint, binned)
         with PROFILER.span("program.forest_predict", rows=n, route=route):
@@ -114,11 +114,18 @@ class _EnsembleSpec:
                 sf, sb, lv, w = self.stacked()
                 return predict_forest_sharded(binned, sf, sb, lv, w,
                                               self.depth, base=self.base)
+            import time as _time
+
             import jax
+            t0 = _time.perf_counter()
             with jax.default_device(list(mesh.devices.flat)[0]):
-                return self.base + predict_forest(binned, self.trees,
-                                                  self.depth,
-                                                  self.tree_weights)
+                out = self.base + predict_forest(binned, self.trees,
+                                                 self.depth,
+                                                 self.tree_weights)
+            # feed the measured traversal rate back into the router
+            dispatch.OBSERVED_HOST.observe(
+                "traverse", hint.flops, _time.perf_counter() - t0)
+            return out
 
     def save(self, path: str) -> None:
         remap_keys = sorted(self.binning.cat_remap)
@@ -306,6 +313,74 @@ class _TreeModelBase(Model, _TreeParams):
         self._spec = _EnsembleSpec.load(path)
 
 
+class _TreeEvalHook:
+    """Evaluator pushdown for lazy tree-regression transforms.
+
+    `RegressionEvaluator` consults this hook on an unmaterialized
+    transform frame: instead of materializing the prediction column
+    (host traversal or a 3.2MB/800k-row D2H) and re-uploading pred/label
+    for the stats pass, the whole predict+metric computes as ONE device
+    program (`inference.forest_eval_fn`) returning five scalars. Falls
+    back (returns None) whenever the shape doesn't fit or the router
+    prices the job hostward — the evaluator then takes the ordinary
+    materialize path, so results never depend on the hook firing."""
+
+    def __init__(self, model, parent):
+        self._model = model
+        self._parent = parent
+        self._stats_cache: dict = {}
+
+    def reg_stats(self, prediction_col: str, label_col: str):
+        cached = self._stats_cache.get((prediction_col, label_col))
+        if cached is not None:
+            return cached  # rmse-then-mae-then-r2 costs one predict, not 3
+        try:
+            model = self._model
+            parent = self._parent
+            if model.getOrDefault("predictionCol") != prediction_col:
+                return None
+            spec = model._spec
+            if spec.mode != "regression" or not hasattr(parent, "toPandas"):
+                return None
+            pdf = parent.toPandas()
+            if label_col not in pdf.columns or len(pdf) == 0:
+                return None
+            X = extract_features(pdf, model.getOrDefault("featuresCol"))
+            # strict conversion, like _pred_label's np.asarray: a
+            # non-numeric label column must raise on the materialize path
+            # and DECLINE here, never silently coerce to NaN
+            lab = np.asarray(pdf[label_col], dtype=np.float64)
+            from ..utils.profiler import PROFILER
+            with PROFILER.span("binning.predict", rows=int(X.shape[0])):
+                binned = bin_with(np.asarray(X, dtype=np.float64),
+                                  spec.binning)
+            n = binned.shape[0]
+            finite = np.isfinite(lab)
+            l32 = np.where(finite, lab, 0.0).astype(np.float32)
+            f32 = finite.astype(np.float32)
+            binned32 = np.ascontiguousarray(binned, dtype=np.int32)
+            hint = dispatch.WorkHint(
+                flops=(4.0 * len(spec.trees) * spec.depth + 10.0) * n,
+                kind="traverse", out_bytes=64.0)
+            from ._staging import routed_for, run_data_parallel
+            with routed_for(hint, binned32, l32, f32) as mesh:
+                if dispatch.is_host_mesh(mesh):
+                    return None  # host route: ordinary path is cheaper
+                from .inference import forest_eval_fn
+                sf, sb, lv, w = spec.stacked()
+                stats = run_data_parallel(
+                    forest_eval_fn(spec.depth), binned32, l32, f32,
+                    replicated=(np.asarray(sf), np.asarray(sb),
+                                np.asarray(lv, dtype=np.float32),
+                                np.asarray(w, dtype=np.float32),
+                                np.float32(spec.base)))
+            out = tuple(float(s) for s in stats)
+            self._stats_cache[(prediction_col, label_col)] = out
+            return out
+        except Exception:
+            return None  # any surprise: the materialize path is correct
+
+
 class _TreeRegressionModel(_TreeModelBase):
     def _transform(self, df):
         oc = self.getOrDefault("predictionCol")
@@ -318,7 +393,9 @@ class _TreeRegressionModel(_TreeModelBase):
             out[oc] = self._margin(out)
             return out
 
-        return df._derive_rowlocal(fn)
+        out = df._derive_rowlocal(fn)
+        out._fused_eval = _TreeEvalHook(self, df)
+        return out
 
 
 class _TreeClassificationModel(_TreeModelBase):

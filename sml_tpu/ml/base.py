@@ -309,6 +309,60 @@ class Pipeline(Estimator):
         self._paramMap[self.getParam("stages")] = stages
 
 
+class _ScorerEvalHook:
+    """Evaluator pushdown for lazy fused pipeline transforms: compute the
+    regression sufficient statistics straight from the RAW parent frame —
+    one columnar featurize pass + the scorer's routed predict — without
+    ever assembling the transform's output frame (vector columns, interim
+    stage columns, prediction series). Returns None whenever the shape
+    doesn't fit; the evaluator then materializes the frame normally, so
+    results never depend on the hook firing."""
+
+    def __init__(self, feat, scorer, tail, parent, prep_stages):
+        self._feat = feat
+        self._scorer = scorer
+        self._tail = tail
+        self._parent = parent
+        self._prep_stages = prep_stages
+        self._stats_cache: dict = {}
+
+    def reg_stats(self, prediction_col: str, label_col: str):
+        cached = self._stats_cache.get((prediction_col, label_col))
+        if cached is not None:
+            return cached  # rmse-then-mae-then-r2 costs one predict, not 3
+        try:
+            from .featurizer import produced_columns
+            tail = self._tail
+            parent = self._parent
+            if tail.getOrDefault("predictionCol") != prediction_col:
+                return None
+            if not hasattr(parent, "toPandas"):
+                return None
+            raw = parent.toPandas()
+            if label_col not in raw.columns or len(raw) == 0:
+                return None
+            # a prep stage that writes labelCol means raw labels are
+            # pre-transform values: the materialize path is authoritative
+            if label_col in produced_columns(self._prep_stages):
+                return None
+            X, keep = self._feat.transform_with_mask(raw)
+            # strict conversion, like _pred_label's np.asarray: a
+            # non-numeric label column must raise on the materialize path
+            # and DECLINE here, never silently coerce to NaN
+            lab = np.asarray(raw[label_col], dtype=np.float64)
+            if keep is not None:
+                lab = lab[keep]
+            pred = np.asarray(self._scorer.score_block(X), dtype=np.float64)
+            if pred.shape[0] != lab.shape[0]:
+                return None
+            from .evaluation import host_reg_stats
+            stats = host_reg_stats(pred, lab)
+            self._stats_cache[(prediction_col, label_col)] = stats
+            return stats
+        except Exception:
+            return None  # any surprise: the materialize path is correct
+
+
 class PipelineModel(Model):
     def _init_params(self):
         pass
@@ -427,24 +481,38 @@ class PipelineModel(Model):
                     index=out.index)
             return _split_rows(out, n_parts)
 
-        # run the pass EAGERLY so a mid-pass surprise (odd dtype, unseen
-        # interim shape) can still fall back to the generic path; consumers
-        # get a materialized frame either way
+        # LAZY: the pass runs at first materialization, like every other
+        # frame op — so an evaluator pushdown (`_fused_eval` hook below) on
+        # a transform that is only ever evaluated never assembles the
+        # output frame at all. A mid-pass surprise (odd dtype, unseen
+        # interim shape) falls back to the generic per-stage chain INSIDE
+        # compute(), so laziness never changes what a consumer sees.
         from ..utils.profiler import PROFILER
-        try:
-            with PROFILER.span("fused_transform",
-                               rows=None, stages=len(self.stages)):
-                parts = compute()
-        except Exception:
-            if debug:
-                raise
-            return None
-        if parts is None:
-            return None
-        res = _DF.from_partitions(parts, session=getattr(df, "_session", None))
+        stages = self.stages
+
+        def compute_or_fallback():
+            try:
+                with PROFILER.span("fused_transform",
+                                   rows=None, stages=len(stages)):
+                    parts = compute()
+                if parts is not None:
+                    return parts
+            except Exception:
+                if debug:
+                    raise
+            cur = parent
+            for s in stages:
+                cur = s.transform(cur)
+            return cur._materialize()
+
+        res = _DF(compute_or_fallback, session=getattr(df, "_session", None),
+                  op="_fast_transform")
         res._ml_attrs = dict(df._ml_attrs)
         res._ml_attrs.update(feat.interim_attrs())
         res._ml_attrs[out_col] = feat.feature_attrs()
+        if scorer is not None:
+            res._fused_eval = _ScorerEvalHook(feat, scorer, tail, df,
+                                              self.stages[:-1])
         return res
 
     def copy(self, extra=None) -> "PipelineModel":

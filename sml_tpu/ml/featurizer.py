@@ -347,11 +347,8 @@ def try_fast_fit(stages, raw_pdf, make_frame):
     return _try_fast_fit(stages, raw_pdf, make_frame)
 
 
-def prep_overwrites_label(prep_stages, est) -> bool:
-    """True when any prep stage's OUTPUT columns collide with the
-    estimator's labelCol/weightCol — the fused fast paths read labels from
-    the RAW pandas, so a stage that rewrites the label there would make
-    them train on pre-transform values. Stages with output params UNSET
+def produced_columns(prep_stages) -> set:
+    """Column names a prep chain WRITES. Stages with output params unset
     write in place (Imputer's outputCols default to inputCols), so the
     input columns count as produced in that case (r4 review)."""
     produced = set()
@@ -377,12 +374,20 @@ def prep_overwrites_label(prep_stages, est) -> bool:
                 elif v:
                     outs.update(v)
         produced |= outs
+    return produced
+
+
+def prep_overwrites_label(prep_stages, est) -> bool:
+    """True when any prep stage's OUTPUT columns collide with the
+    estimator's labelCol/weightCol — the fused fast paths read labels from
+    the RAW pandas, so a stage that rewrites the label there would make
+    them train on pre-transform values."""
     label_like = {est.getOrDefault("labelCol")}
     if est.hasParam("weightCol"):
         w = est.getOrDefault("weightCol")
         if w:
             label_like.add(w)
-    return bool(produced & label_like)
+    return bool(produced_columns(prep_stages) & label_like)
 
 
 def _try_fast_fit(stages, raw_pdf, make_frame):

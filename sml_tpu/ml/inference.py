@@ -33,21 +33,29 @@ def _logistic_forward(Xb, mask, w, b):
     return jax.nn.sigmoid(Xb @ w + b) * mask
 
 
+def _forest_margin(binned_b, sf, sb, lv, weights, depth: int):
+    """Weighted stacked-ensemble margin for one row block — the SINGLE
+    traversal kernel shared by the predict program and the fused
+    predict+eval program (a semantics fix must land in exactly one
+    place)."""
+    def one_tree(f, s, v):
+        node = jnp.zeros((binned_b.shape[0],), dtype=jnp.int32)
+        for _ in range(depth):
+            feat = f[node]
+            thr = s[node]
+            xbin = jnp.take_along_axis(
+                binned_b, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+            child = 2 * node + 1 + (xbin > thr).astype(jnp.int32)
+            node = jnp.where(feat >= 0, child, node)
+        return v[node]
+
+    per_tree = jax.vmap(one_tree)(sf, sb, lv)          # (T, rows/chip)
+    return jnp.tensordot(weights, per_tree, axes=1)
+
+
 def _make_forest_forward(depth: int):
     def forest_forward(binned_b, mask, sf, sb, lv, weights):
-        def one_tree(f, s, v):
-            node = jnp.zeros((binned_b.shape[0],), dtype=jnp.int32)
-            for _ in range(depth):
-                feat = f[node]
-                thr = s[node]
-                xbin = jnp.take_along_axis(
-                    binned_b, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-                child = 2 * node + 1 + (xbin > thr).astype(jnp.int32)
-                node = jnp.where(feat >= 0, child, node)
-            return v[node]
-
-        per_tree = jax.vmap(one_tree)(sf, sb, lv)      # (T, rows/chip)
-        return jnp.tensordot(weights, per_tree, axes=1) * mask
+        return _forest_margin(binned_b, sf, sb, lv, weights, depth) * mask
 
     return forest_forward
 
@@ -63,6 +71,41 @@ def _forest_program(depth: int):
             _make_forest_forward(depth), out_replicated=False,
             replicated_argnums=(2, 3, 4, 5))
     return _forest_programs[key]
+
+
+_forest_eval_fns: dict = {}
+
+
+def forest_eval_fn(depth: int):
+    """Fused predict+metric program for the evaluator pushdown: traverse
+    the stacked ensemble AND reduce the five regression sufficient
+    statistics in one dispatch — D2H is five scalars instead of a
+    predictions column (3.2MB at the tunnel's ~20MB/s D2H dominated every
+    CV/tuning eval). `lmask` is 1.0 where the label is finite (matching
+    `_pred_label`'s finite filter); labels are pre-zeroed at masked rows so
+    padding and NaN labels are inert under psum.
+
+    Module-level per-depth fn identity so cached_data_parallel's program
+    cache hits across calls."""
+    fn = _forest_eval_fns.get(depth)
+    if fn is not None:
+        return fn
+
+    def forest_eval(binned_b, l, lmask, mask, sf, sb, lv, weights, base):
+        pred = base + _forest_margin(binned_b, sf, sb, lv, weights, depth)
+        m = mask * lmask
+        d = (pred - l) * m
+        from ..parallel import collectives as _coll
+        n = _coll.psum(jnp.sum(m))
+        se = _coll.psum(jnp.sum(d * d))
+        ae = _coll.psum(jnp.sum(jnp.abs(d)))
+        sl = _coll.psum(jnp.sum(m * l))
+        sl2 = _coll.psum(jnp.sum(m * l * l))
+        return n, se, ae, sl, sl2
+
+    forest_eval.__name__ = f"forest_eval_d{depth}"
+    _forest_eval_fns[depth] = forest_eval
+    return forest_eval
 
 
 def _stage_rows(X: np.ndarray):
@@ -201,14 +244,19 @@ class DeviceScorer:
         binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
         n = binned.shape[0]
         hint = _dispatch_mod.WorkHint(
-            flops=4.0 * n * len(spec.trees) * spec.depth, kind="scatter",
+            flops=4.0 * n * len(spec.trees) * spec.depth, kind="traverse",
             out_bytes=4.0 * n)
         mesh, route = route_for_arrays(hint, binned)
         if route == "host":
+            import time as _time
+
             import jax as _jax
+            t0 = _time.perf_counter()
             with _jax.default_device(list(mesh.devices.flat)[0]):
                 margin = predict_forest(binned, spec.trees, spec.depth,
                                         spec.tree_weights)
+            _dispatch_mod.OBSERVED_HOST.observe(
+                "traverse", hint.flops, _time.perf_counter() - t0)
             return margin, n, finalize
         Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, np.int32))
         prog = _forest_program(spec.depth)

@@ -49,17 +49,69 @@ _register("sml.dispatch.autoPromote", True, _to_bool,
           "device-resident copy would beat the host, so repeated fits "
           "(CV folds, tuning trials) converge onto the chip")
 
-# effective host rates (elementwise ops/s) per program family; conservative
-# (over-crediting the host only steers SMALL jobs hostward, where the fixed
-# device latency dominates any estimation error)
+# effective host rates (elementwise ops/s) per program family — the
+# BOOTSTRAP values only: every hinted host execution feeds its measured
+# flops/sec back into OBSERVED_HOST below, so routing converges onto this
+# host's real throughput instead of a constant. Bootstraps stay
+# conservative (over-crediting the host only steers SMALL jobs hostward,
+# where the fixed device latency dominates any estimation error).
 _HOST_RATES = {
     # measured on THIS host's 1-device mesh (XLA:CPU): Gram at 2M rows ran
     # 3.8e9 flops in ~0.7s; the ensemble one-hot program 4.6e9 in ~3.8s
     "blas": 6e9,       # dense matmul-shaped work (Gram, forward passes)
-    "scatter": 1.2e9,  # histogram/one-hot accumulation, tree traversal
+    "scatter": 1.2e9,  # histogram/one-hot accumulation
     "scan": 1.0e9,     # long sequential scans (boosting rounds, ARIMA)
+    # per-tree numpy traversal loop (predict): measured ~2e8 ops/s at 800k
+    # rows — 6x below the histogram kernels; pricing predicts with the
+    # "scatter" rate routed every forest predict hostward and cost the r4
+    # bench 13.6s of host traversal on data already resident in HBM
+    "traverse": 2.5e8,
 }
 _DEVICE_RATE = 2e12  # sustained non-MXU-peak device throughput estimate
+
+
+class _ObservedRates:
+    """MEASURED host throughput per WorkHint kind.
+
+    The router's host-side cost model can only be as good as its rates;
+    hard-coded constants were wrong by 6x for tree traversal (r4). Every
+    hinted host execution calls `observe(kind, flops, seconds)` with its
+    wall time; `host_time` prefers the observed estimate.
+
+    The estimate is the MAX over a window of recent observations, not an
+    EWMA: a first-call timing that includes an XLA:CPU jit compile (or a
+    GC pause) under-reports the host's capability, and with an EWMA one
+    such sample could flip marginal work onto the tunneled device — where
+    no further host observations ever correct it. Max-of-window means a
+    slow outlier only wins while it is the ONLY evidence; any steady-state
+    repeat restores the true rate, while a genuinely slow host (every
+    sample slow) still converges down."""
+
+    _WINDOW = 8
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._recent: dict = {}  # kind -> deque of recent rates
+
+    def observe(self, kind: str, flops: float, seconds: float) -> None:
+        # sub-ms timings are dominated by timer noise / python overhead
+        if seconds < 1e-3 or flops <= 0:
+            return
+        from collections import deque
+        rate = flops / seconds
+        with self._lock:
+            dq = self._recent.get(kind)
+            if dq is None:
+                dq = self._recent[kind] = deque(maxlen=self._WINDOW)
+            dq.append(rate)
+
+    def rate(self, kind: str):
+        with self._lock:
+            dq = self._recent.get(kind)
+            return max(dq) if dq else None
+
+
+OBSERVED_HOST = _ObservedRates()
 
 
 @dataclass(frozen=True)
@@ -160,7 +212,9 @@ def device_time(hint: WorkHint, cal: _Calibration) -> float:
 
 
 def host_time(hint: WorkHint) -> float:
-    return hint.flops / _HOST_RATES.get(hint.kind, _HOST_RATES["blas"])
+    rate = OBSERVED_HOST.rate(hint.kind) \
+        or _HOST_RATES.get(hint.kind, _HOST_RATES["blas"])
+    return hint.flops / rate
 
 
 def preroute(hint: Optional[WorkHint]) -> Optional[str]:

@@ -145,3 +145,32 @@ def test_bucket_rows_buckets_and_divides():
             prev = b
     # nearby sizes share a bucket (the compile-cache point of bucketing)
     assert bucket_rows(40_000, 8) == bucket_rows(40_011, 8)
+
+
+def test_observed_host_rates_steer_routing(tunneled, monkeypatch):
+    """The router's host cost model self-corrects from measured wall times
+    (r4: the hard-coded scatter rate over-credited tree traversal 6x and
+    routed 13.6s of forest predicts onto the host). An observed slow rate
+    must flip a marginal job to the device; fresh state must fall back to
+    the bootstrap constant."""
+    monkeypatch.setattr(dispatch, "OBSERVED_HOST", dispatch._ObservedRates())
+    hint = WorkHint(flops=2e8, kind="traverse", out_bytes=256.0)
+    # bootstrap: 2e8 ops at 2.5e8 ops/s = 0.8s host vs ~0.15s device
+    assert dispatch.host_time(hint) == pytest.approx(0.8)
+    # a measured FAST host (1e10 ops/s) flips the comparison hostward
+    dispatch.OBSERVED_HOST.observe("traverse", 2e9, 0.2)
+    assert dispatch.host_time(hint) < 0.05
+    assert dispatch.decide(hint)[0] == "host"
+    # one slow sample must NOT displace the fast evidence (max-of-window:
+    # compile-inflated first calls cannot poison the estimate)
+    dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
+    assert dispatch.decide(hint)[0] == "host"
+    # ... but a full window of slow samples is real evidence → device
+    for _ in range(8):
+        dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
+    assert dispatch.decide(hint)[0] == "device"
+    # sub-ms and zero-flop observations are ignored (timer noise)
+    before = dispatch.OBSERVED_HOST.rate("traverse")
+    dispatch.OBSERVED_HOST.observe("traverse", 1e9, 1e-5)
+    dispatch.OBSERVED_HOST.observe("traverse", 0.0, 1.0)
+    assert dispatch.OBSERVED_HOST.rate("traverse") == before
