@@ -54,6 +54,9 @@ _register("sml.profiler.enabled", False, _to_bool, "Record op-level timings")
 _register("sml.applyInPandas.parallelism", 8, int,
           "Concurrent per-group fn threads in applyInPandas; 1 = sequential "
           "(needed only by fns that mutate shared closure state)")
+_register("sml.predict.binCacheBytes", 1 << 30, int,
+          "LRU byte bound for memoized predict-time binned matrices (CV/"
+          "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
 
 
 class TpuConf:

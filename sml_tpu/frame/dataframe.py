@@ -157,6 +157,11 @@ class DataFrame:
             # which would otherwise pin every intermediate's partitions in
             # memory for the lifetime of this frame.
             self._compute = None  # type: ignore[assignment]
+            # An evaluator-pushdown hook is dead once the frame is
+            # materialized (the evaluator only consults it pre-materialize);
+            # drop it so it stops pinning the parent frame's partitions.
+            if self.__dict__.get("_fused_eval") is not None:
+                self.__dict__["_fused_eval"] = None
         return self._parts
 
     def _contexts(self) -> List[EvalContext]:

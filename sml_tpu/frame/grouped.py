@@ -123,23 +123,28 @@ class GroupedData:
             pdf, key_names = parent._grouped()
             if len(pdf) == 0:
                 return [coerce_to_schema(pd.DataFrame(), sch)]
-            groups = [g.reset_index(drop=True) for _, g in
-                      pdf.groupby(key_names, sort=False, dropna=False)]
+            gb = pdf.groupby(key_names, sort=False, dropna=False)
             par = GLOBAL_CONF.getInt("sml.applyInPandas.parallelism")
-            if len(groups) > 1 and par > 1:
+            if gb.ngroups > 1 and par > 1:
                 # per-group fns run concurrently, as on Spark executors
                 # (P8): sklearn/numpy payloads release the GIL in BLAS.
+                # Groups are SUBMITTED as the groupby iterator yields them,
+                # so worker fns overlap with the remaining group extraction
+                # (the per-group take of a wide object-column frame is the
+                # expensive half of the split).
                 # NOTE these are threads of ONE interpreter — a fn that
                 # mutates shared closure state needs
                 # sml.applyInPandas.parallelism=1 (Spark's process-isolated
                 # workers could never share state in the first place)
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(
-                        max_workers=min(par, len(groups))) as ex:
-                    outs = [coerce_to_schema(r, sch)
-                            for r in ex.map(fn, groups)]
+                        max_workers=min(par, gb.ngroups)) as ex:
+                    futs = [ex.submit(fn, g.reset_index(drop=True))
+                            for _, g in gb]
+                    outs = [coerce_to_schema(f.result(), sch) for f in futs]
             else:
-                outs = [coerce_to_schema(fn(g), sch) for g in groups]
+                outs = [coerce_to_schema(fn(g.reset_index(drop=True)), sch)
+                        for _, g in gb]
             full = pd.concat(outs, ignore_index=True)
             nparts = min(len(outs), GLOBAL_CONF.getInt("sml.shuffle.partitions"))
             avail = [k for k in key_names if k in full.columns]

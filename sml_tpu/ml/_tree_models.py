@@ -114,18 +114,12 @@ class _EnsembleSpec:
                 sf, sb, lv, w = self.stacked()
                 return predict_forest_sharded(binned, sf, sb, lv, w,
                                               self.depth, base=self.base)
-            import time as _time
-
             import jax
-            t0 = _time.perf_counter()
-            with jax.default_device(list(mesh.devices.flat)[0]):
-                out = self.base + predict_forest(binned, self.trees,
-                                                 self.depth,
-                                                 self.tree_weights)
-            # feed the measured traversal rate back into the router
-            dispatch.OBSERVED_HOST.observe(
-                "traverse", hint.flops, _time.perf_counter() - t0)
-            return out
+            with dispatch.observe_host("traverse", hint.flops), \
+                    jax.default_device(list(mesh.devices.flat)[0]):
+                return self.base + predict_forest(binned, self.trees,
+                                                  self.depth,
+                                                  self.tree_weights)
 
     def save(self, path: str) -> None:
         remap_keys = sorted(self.binning.cat_remap)
@@ -313,6 +307,43 @@ class _TreeModelBase(Model, _TreeParams):
         self._spec = _EnsembleSpec.load(path)
 
 
+def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray):
+    """The fused traverse+metric device pass over a raw feature matrix:
+    bins (content-memoized), routes, and — on the device route — returns
+    the five regression sufficient statistics from ONE program dispatch
+    (D2H is five scalars). Returns None on the host route or any surprise;
+    callers then take the ordinary predict+stats path. Shared by the bare
+    tree-model hook and the fused-pipeline hook."""
+    if spec.mode != "regression":
+        return None
+    from ..utils.profiler import PROFILER
+    with PROFILER.span("binning.predict", rows=int(X.shape[0])):
+        binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
+    n = binned.shape[0]
+    if n != len(lab):
+        return None
+    finite = np.isfinite(lab)
+    l32 = np.where(finite, lab, 0.0).astype(np.float32)
+    f32 = finite.astype(np.float32)
+    binned32 = np.ascontiguousarray(binned, dtype=np.int32)
+    hint = dispatch.WorkHint(
+        flops=(4.0 * len(spec.trees) * spec.depth + 10.0) * n,
+        kind="traverse", out_bytes=64.0)
+    from ._staging import routed_for, run_data_parallel
+    with routed_for(hint, binned32, l32, f32) as mesh:
+        if dispatch.is_host_mesh(mesh):
+            return None  # host route: ordinary path is cheaper
+        from .inference import forest_eval_fn
+        sf, sb, lv, w = spec.stacked()
+        stats = run_data_parallel(
+            forest_eval_fn(spec.depth), binned32, l32, f32,
+            replicated=(np.asarray(sf), np.asarray(sb),
+                        np.asarray(lv, dtype=np.float32),
+                        np.asarray(w, dtype=np.float32),
+                        np.float32(spec.base)))
+    return tuple(float(s) for s in stats)
+
+
 class _TreeEvalHook:
     """Evaluator pushdown for lazy tree-regression transforms.
 
@@ -339,8 +370,7 @@ class _TreeEvalHook:
             parent = self._parent
             if model.getOrDefault("predictionCol") != prediction_col:
                 return None
-            spec = model._spec
-            if spec.mode != "regression" or not hasattr(parent, "toPandas"):
+            if not hasattr(parent, "toPandas"):
                 return None
             pdf = parent.toPandas()
             if label_col not in pdf.columns or len(pdf) == 0:
@@ -350,32 +380,9 @@ class _TreeEvalHook:
             # non-numeric label column must raise on the materialize path
             # and DECLINE here, never silently coerce to NaN
             lab = np.asarray(pdf[label_col], dtype=np.float64)
-            from ..utils.profiler import PROFILER
-            with PROFILER.span("binning.predict", rows=int(X.shape[0])):
-                binned = bin_with(np.asarray(X, dtype=np.float64),
-                                  spec.binning)
-            n = binned.shape[0]
-            finite = np.isfinite(lab)
-            l32 = np.where(finite, lab, 0.0).astype(np.float32)
-            f32 = finite.astype(np.float32)
-            binned32 = np.ascontiguousarray(binned, dtype=np.int32)
-            hint = dispatch.WorkHint(
-                flops=(4.0 * len(spec.trees) * spec.depth + 10.0) * n,
-                kind="traverse", out_bytes=64.0)
-            from ._staging import routed_for, run_data_parallel
-            with routed_for(hint, binned32, l32, f32) as mesh:
-                if dispatch.is_host_mesh(mesh):
-                    return None  # host route: ordinary path is cheaper
-                from .inference import forest_eval_fn
-                sf, sb, lv, w = spec.stacked()
-                stats = run_data_parallel(
-                    forest_eval_fn(spec.depth), binned32, l32, f32,
-                    replicated=(np.asarray(sf), np.asarray(sb),
-                                np.asarray(lv, dtype=np.float32),
-                                np.asarray(w, dtype=np.float32),
-                                np.float32(spec.base)))
-            out = tuple(float(s) for s in stats)
-            self._stats_cache[(prediction_col, label_col)] = out
+            out = fused_reg_stats_from_matrix(model._spec, X, lab)
+            if out is not None:
+                self._stats_cache[(prediction_col, label_col)] = out
             return out
         except Exception:
             return None  # any surprise: the materialize path is correct

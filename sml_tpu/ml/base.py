@@ -352,6 +352,15 @@ class _ScorerEvalHook:
             lab = np.asarray(raw[label_col], dtype=np.float64)
             if keep is not None:
                 lab = lab[keep]
+            spec = getattr(tail, "_spec", None)
+            if spec is not None and hasattr(spec, "trees"):
+                # tree tail: the whole traverse+metric fuses into one
+                # device program (five-scalar D2H) when the router agrees
+                from ._tree_models import fused_reg_stats_from_matrix
+                stats = fused_reg_stats_from_matrix(spec, X, lab)
+                if stats is not None:
+                    self._stats_cache[(prediction_col, label_col)] = stats
+                    return stats
             pred = np.asarray(self._scorer.score_block(X), dtype=np.float64)
             if pred.shape[0] != lab.shape[0]:
                 return None

@@ -132,7 +132,7 @@ class RegressionEvaluator(Evaluator):
             return _reg_metric(metric, *host_reg_stats(pred, lab))
         n, se, ae, sl, sl2 = run_data_parallel(
             _reg_stats, pred.astype(np.float32), lab.astype(np.float32),
-            work=WorkHint(flops=10.0 * len(pred), kind="blas"))
+            work=hint)
         return _reg_metric(metric, float(n), float(se), float(ae),
                            float(sl), float(sl2))
 

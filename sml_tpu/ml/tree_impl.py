@@ -148,7 +148,11 @@ import threading as _threading
 
 _predict_bin_cache: dict = {}
 _predict_bin_lock = _threading.Lock()  # CV trials bin concurrently
-_PREDICT_BIN_CACHE_MAX = 8
+# bytes-bounded LRU (sml.predict.binCacheBytes): the CV/tuning suite
+# legitimately holds ~20 distinct (matrix, model-edges) pairs at once
+# (each fold's models re-bin the val matrix with their OWN quantile
+# edges); an 8-entry cap thrashed every pass and re-paid ~0.3s of
+# digitize per eval (r4 profile: 6.2s/pass)
 
 
 def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
@@ -166,14 +170,22 @@ def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
     key = (_memo_key(Xn), edge_key)
     with _predict_bin_lock:
         hit = _predict_bin_cache.get(key)
+        if hit is not None:
+            # move-to-end LRU touch: dicts iterate in insertion order
+            _predict_bin_cache.pop(key)
+            _predict_bin_cache[key] = hit
     if hit is not None:
         return hit
     edge_list = [binning.edges[f][np.isfinite(binning.edges[f])]
                  for f in range(X.shape[1])]
     out = _bin_columns(Xn, edge_list, binning.cat_remap)
+    from ..conf import GLOBAL_CONF
+    max_bytes = GLOBAL_CONF.getInt("sml.predict.binCacheBytes")
     with _predict_bin_lock:
-        while len(_predict_bin_cache) >= _PREDICT_BIN_CACHE_MAX:
-            _predict_bin_cache.pop(next(iter(_predict_bin_cache)))
+        total = out.nbytes + sum(v.nbytes for v in _predict_bin_cache.values())
+        while total > max_bytes and _predict_bin_cache:
+            oldest = next(iter(_predict_bin_cache))
+            total -= _predict_bin_cache.pop(oldest).nbytes
         _predict_bin_cache[key] = out
     return out
 

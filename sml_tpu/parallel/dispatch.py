@@ -78,40 +78,61 @@ class _ObservedRates:
     hinted host execution calls `observe(kind, flops, seconds)` with its
     wall time; `host_time` prefers the observed estimate.
 
-    The estimate is the MAX over a window of recent observations, not an
-    EWMA: a first-call timing that includes an XLA:CPU jit compile (or a
-    GC pause) under-reports the host's capability, and with an EWMA one
-    such sample could flip marginal work onto the tunneled device — where
-    no further host observations ever correct it. Max-of-window means a
-    slow outlier only wins while it is the ONLY evidence; any steady-state
-    repeat restores the true rate, while a genuinely slow host (every
-    sample slow) still converges down."""
+    The estimate is THROUGHPUT-WEIGHTED over a window of recent large
+    observations — sum(flops)/sum(seconds) — not an EWMA or a max:
+
+    - an EWMA lets one compile-inflated first call flip marginal work onto
+      the tunneled device, where no further host samples ever correct it;
+    - a max-of-window lets one warm SMALL call (whose per-op overhead
+      profile looks nothing like an 800k-row traversal) over-credit the
+      host for big jobs — r4 saw exactly this flapping, with 266k-row CV
+      evals bouncing to a host path that cost ~1.4s each;
+    - throughput weighting makes big calls dominate the estimate in
+      proportion to the work they did, which is what routing big calls
+      needs, while the flops floor keeps tiny-call noise out entirely."""
 
     _WINDOW = 8
+    _MIN_FLOPS = 1e8  # below this, per-call overhead ≈ the signal
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._recent: dict = {}  # kind -> deque of recent rates
+        self._recent: dict = {}  # kind -> deque of (flops, seconds)
 
     def observe(self, kind: str, flops: float, seconds: float) -> None:
         # sub-ms timings are dominated by timer noise / python overhead
-        if seconds < 1e-3 or flops <= 0:
+        if seconds < 1e-3 or flops < self._MIN_FLOPS:
             return
         from collections import deque
-        rate = flops / seconds
         with self._lock:
             dq = self._recent.get(kind)
             if dq is None:
                 dq = self._recent[kind] = deque(maxlen=self._WINDOW)
-            dq.append(rate)
+            dq.append((flops, seconds))
 
     def rate(self, kind: str):
         with self._lock:
             dq = self._recent.get(kind)
-            return max(dq) if dq else None
+            if not dq:
+                return None
+            return sum(f for f, _ in dq) / sum(s for _, s in dq)
 
 
 OBSERVED_HOST = _ObservedRates()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def observe_host(kind: str, flops: float):
+    """Time a host-route execution and feed the measured rate back into
+    the router — the ONE definition of what gets observed, shared by every
+    host predict path."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        OBSERVED_HOST.observe(kind, flops, time.perf_counter() - t0)
 
 
 @dataclass(frozen=True)

@@ -157,20 +157,21 @@ def test_observed_host_rates_steer_routing(tunneled, monkeypatch):
     hint = WorkHint(flops=2e8, kind="traverse", out_bytes=256.0)
     # bootstrap: 2e8 ops at 2.5e8 ops/s = 0.8s host vs ~0.15s device
     assert dispatch.host_time(hint) == pytest.approx(0.8)
-    # a measured FAST host (1e10 ops/s) flips the comparison hostward
-    dispatch.OBSERVED_HOST.observe("traverse", 2e9, 0.2)
+    # a measured FAST host (1e10 ops/s over real work) flips hostward
+    dispatch.OBSERVED_HOST.observe("traverse", 2e10, 2.0)
     assert dispatch.host_time(hint) < 0.05
     assert dispatch.decide(hint)[0] == "host"
-    # one slow sample must NOT displace the fast evidence (max-of-window:
-    # compile-inflated first calls cannot poison the estimate)
-    dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
+    # one compile-inflated sample only dilutes in proportion to its work —
+    # the fast big-call evidence still dominates the weighted rate
+    dispatch.OBSERVED_HOST.observe("traverse", 2e8, 2.0)
     assert dispatch.decide(hint)[0] == "host"
-    # ... but a full window of slow samples is real evidence → device
+    # ... but a full window of genuinely slow samples is real evidence
     for _ in range(8):
-        dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
+        dispatch.OBSERVED_HOST.observe("traverse", 2e8, 2.0)
     assert dispatch.decide(hint)[0] == "device"
-    # sub-ms and zero-flop observations are ignored (timer noise)
+    # sub-ms, sub-floor, and zero-flop observations are ignored (noise)
     before = dispatch.OBSERVED_HOST.rate("traverse")
     dispatch.OBSERVED_HOST.observe("traverse", 1e9, 1e-5)
+    dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
     dispatch.OBSERVED_HOST.observe("traverse", 0.0, 1.0)
     assert dispatch.OBSERVED_HOST.rate("traverse") == before
