@@ -22,7 +22,7 @@ import numpy as np
 import pandas as pd
 
 from ..parallel import dispatch
-from .base import Estimator, Model, load_arrays, save_arrays
+from .base import Estimator, Model, RegStatsHook, load_arrays, save_arrays
 from .feature import _as_object_series
 from .linalg import DenseVector, vector_series
 from ._staging import extract_features, extract_xy
@@ -344,48 +344,18 @@ def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray):
     return tuple(float(s) for s in stats)
 
 
-class _TreeEvalHook:
-    """Evaluator pushdown for lazy tree-regression transforms.
+class _TreeEvalHook(RegStatsHook):
+    """Evaluator pushdown for lazy BARE tree-regression transforms (the
+    CV/tuning shape: model.transform(featurized_frame)): the whole
+    predict+metric computes as ONE device program
+    (`inference.forest_eval_fn`) returning five scalars, instead of
+    materializing a prediction column (host traversal or a 3.2MB/800k-row
+    D2H) and re-uploading pred/label for the stats pass."""
 
-    `RegressionEvaluator` consults this hook on an unmaterialized
-    transform frame: instead of materializing the prediction column
-    (host traversal or a 3.2MB/800k-row D2H) and re-uploading pred/label
-    for the stats pass, the whole predict+metric computes as ONE device
-    program (`inference.forest_eval_fn`) returning five scalars. Falls
-    back (returns None) whenever the shape doesn't fit or the router
-    prices the job hostward — the evaluator then takes the ordinary
-    materialize path, so results never depend on the hook firing."""
-
-    def __init__(self, model, parent):
-        self._model = model
-        self._parent = parent
-        self._stats_cache: dict = {}
-
-    def reg_stats(self, prediction_col: str, label_col: str):
-        cached = self._stats_cache.get((prediction_col, label_col))
-        if cached is not None:
-            return cached  # rmse-then-mae-then-r2 costs one predict, not 3
-        try:
-            model = self._model
-            parent = self._parent
-            if model.getOrDefault("predictionCol") != prediction_col:
-                return None
-            if not hasattr(parent, "toPandas"):
-                return None
-            pdf = parent.toPandas()
-            if label_col not in pdf.columns or len(pdf) == 0:
-                return None
-            X = extract_features(pdf, model.getOrDefault("featuresCol"))
-            # strict conversion, like _pred_label's np.asarray: a
-            # non-numeric label column must raise on the materialize path
-            # and DECLINE here, never silently coerce to NaN
-            lab = np.asarray(pdf[label_col], dtype=np.float64)
-            out = fused_reg_stats_from_matrix(model._spec, X, lab)
-            if out is not None:
-                self._stats_cache[(prediction_col, label_col)] = out
-            return out
-        except Exception:
-            return None  # any surprise: the materialize path is correct
+    def _compute(self, raw, lab, label_col: str):
+        model = self._tail
+        X = extract_features(raw, model.getOrDefault("featuresCol"))
+        return fused_reg_stats_from_matrix(model._spec, X, lab)
 
 
 class _TreeRegressionModel(_TreeModelBase):

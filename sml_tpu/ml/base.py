@@ -309,67 +309,92 @@ class Pipeline(Estimator):
         self._paramMap[self.getParam("stages")] = stages
 
 
-class _ScorerEvalHook:
-    """Evaluator pushdown for lazy fused pipeline transforms: compute the
-    regression sufficient statistics straight from the RAW parent frame —
-    one columnar featurize pass + the scorer's routed predict — without
-    ever assembling the transform's output frame (vector columns, interim
-    stage columns, prediction series). Returns None whenever the shape
-    doesn't fit; the evaluator then materializes the frame normally, so
-    results never depend on the hook firing."""
+class RegStatsHook:
+    """Base evaluator-pushdown hook for lazy model-transform frames.
 
-    def __init__(self, feat, scorer, tail, parent, prep_stages):
-        self._feat = feat
-        self._scorer = scorer
+    `RegressionEvaluator` consults `reg_stats` on an UNMATERIALIZED
+    transform frame: a subclass computes the five regression sufficient
+    statistics straight from the raw parent frame, without assembling the
+    transform's output. This class owns the shared scaffolding — the
+    (prediction_col, label_col) stats cache, the predictionCol/parent/
+    label guards, the strict label conversion (a non-numeric label column
+    must raise on the materialize path and DECLINE here, never silently
+    coerce to NaN), and the decline-on-any-surprise contract — so the
+    producers cannot drift apart. Subclasses implement `_compute(raw,
+    lab, label_col)` and may override `_label_ok`. Returning None always
+    means: the evaluator takes the ordinary materialize path, so results
+    never depend on the hook firing."""
+
+    def __init__(self, tail, parent):
         self._tail = tail
         self._parent = parent
-        self._prep_stages = prep_stages
         self._stats_cache: dict = {}
+
+    def _label_ok(self, label_col: str) -> bool:
+        return True
+
+    def _compute(self, raw, lab, label_col: str):
+        raise NotImplementedError
 
     def reg_stats(self, prediction_col: str, label_col: str):
         cached = self._stats_cache.get((prediction_col, label_col))
         if cached is not None:
             return cached  # rmse-then-mae-then-r2 costs one predict, not 3
         try:
-            from .featurizer import produced_columns
-            tail = self._tail
-            parent = self._parent
-            if tail.getOrDefault("predictionCol") != prediction_col:
+            if self._tail.getOrDefault("predictionCol") != prediction_col:
                 return None
-            if not hasattr(parent, "toPandas"):
+            if not hasattr(self._parent, "toPandas"):
                 return None
-            raw = parent.toPandas()
+            raw = self._parent.toPandas()
             if label_col not in raw.columns or len(raw) == 0:
                 return None
-            # a prep stage that writes labelCol means raw labels are
-            # pre-transform values: the materialize path is authoritative
-            if label_col in produced_columns(self._prep_stages):
+            if not self._label_ok(label_col):
                 return None
-            X, keep = self._feat.transform_with_mask(raw)
-            # strict conversion, like _pred_label's np.asarray: a
-            # non-numeric label column must raise on the materialize path
-            # and DECLINE here, never silently coerce to NaN
             lab = np.asarray(raw[label_col], dtype=np.float64)
-            if keep is not None:
-                lab = lab[keep]
-            spec = getattr(tail, "_spec", None)
-            if spec is not None and hasattr(spec, "trees"):
-                # tree tail: the whole traverse+metric fuses into one
-                # device program (five-scalar D2H) when the router agrees
-                from ._tree_models import fused_reg_stats_from_matrix
-                stats = fused_reg_stats_from_matrix(spec, X, lab)
-                if stats is not None:
-                    self._stats_cache[(prediction_col, label_col)] = stats
-                    return stats
-            pred = np.asarray(self._scorer.score_block(X), dtype=np.float64)
-            if pred.shape[0] != lab.shape[0]:
-                return None
-            from .evaluation import host_reg_stats
-            stats = host_reg_stats(pred, lab)
-            self._stats_cache[(prediction_col, label_col)] = stats
+            stats = self._compute(raw, lab, label_col)
+            if stats is not None:
+                self._stats_cache[(prediction_col, label_col)] = stats
             return stats
         except Exception:
             return None  # any surprise: the materialize path is correct
+
+
+class _ScorerEvalHook(RegStatsHook):
+    """Pushdown for lazy fused pipeline transforms: one columnar
+    featurize pass + the scorer's routed predict (or, for tree tails,
+    the fused traverse+metric device program), with no output-frame
+    assembly (vector columns, interim stage columns, prediction
+    series)."""
+
+    def __init__(self, feat, scorer, tail, parent, prep_stages):
+        super().__init__(tail, parent)
+        self._feat = feat
+        self._scorer = scorer
+        self._prep_stages = prep_stages
+
+    def _label_ok(self, label_col: str) -> bool:
+        # a prep stage that writes labelCol means raw labels are
+        # pre-transform values: the materialize path is authoritative
+        from .featurizer import produced_columns
+        return label_col not in produced_columns(self._prep_stages)
+
+    def _compute(self, raw, lab, label_col: str):
+        X, keep = self._feat.transform_with_mask(raw)
+        if keep is not None:
+            lab = lab[keep]
+        spec = getattr(self._tail, "_spec", None)
+        if spec is not None and hasattr(spec, "trees"):
+            # tree tail: the whole traverse+metric fuses into one device
+            # program (five-scalar D2H) when the router agrees
+            from ._tree_models import fused_reg_stats_from_matrix
+            stats = fused_reg_stats_from_matrix(spec, X, lab)
+            if stats is not None:
+                return stats
+        pred = np.asarray(self._scorer.score_block(X), dtype=np.float64)
+        if pred.shape[0] != lab.shape[0]:
+            return None
+        from .evaluation import host_reg_stats
+        return host_reg_stats(pred, lab)
 
 
 class PipelineModel(Model):

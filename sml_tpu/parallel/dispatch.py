@@ -66,6 +66,11 @@ _HOST_RATES = {
     # "scatter" rate routed every forest predict hostward and cost the r4
     # bench 13.6s of host traversal on data already resident in HBM
     "traverse": 2.5e8,
+    # argsort + reduceat segment reductions (host ALS normal equations):
+    # measured ~8e7 effective ops/s against the nnz·rank² estimate — the
+    # "blas" rate over-credited the host ~75x and silently routed whole
+    # MovieLens-scale ALS fits onto a 14s host path
+    "segment": 8e7,
 }
 _DEVICE_RATE = 2e12  # sustained non-MXU-peak device throughput estimate
 

@@ -326,3 +326,31 @@ def test_fused_fit_skips_when_prep_overwrites_label(explicit_outputs):
     ]).fit(get_session().createDataFrame(ref_pdf)).stages[-1]
     np.testing.assert_allclose(coef, ref.coefficients.toArray(), rtol=1e-5)
     np.testing.assert_allclose(lr.intercept, ref.intercept, rtol=1e-5)
+
+
+def test_arrow_index_fast_path_semantics():
+    """The pyarrow index_in fast path must match get_indexer semantics:
+    nulls and unseen labels → NaN codes, and it must DECLINE non-string
+    arrow columns — a numeric cast can collapse distinct labels like
+    "1"/"1.0" onto one value (r4 review finding)."""
+    import pandas as pd
+
+    from sml_tpu.ml.featurizer import _IndexSource
+
+    s = _IndexSource("c", np.array(["a", "b", "c"]), "keep")
+    col = pd.Series(["b", None, "zz", "a", "c"], dtype="str")
+    codes = s.codes(pd.DataFrame({"c": col}))
+    assert codes[0] == 1.0 and codes[3] == 0.0 and codes[4] == 2.0
+    assert np.isnan(codes[1]) and np.isnan(codes[2])
+    # object-dtype fallback agrees
+    codes_obj = s.codes(pd.DataFrame({"c": col.astype(object)}))
+    np.testing.assert_array_equal(np.isnan(codes), np.isnan(codes_obj))
+    np.testing.assert_array_equal(codes[~np.isnan(codes)],
+                                  codes_obj[~np.isnan(codes_obj)])
+    # numeric labels over a float arrow column: fast path declines, and
+    # string-comparison semantics pick the exact textual match
+    s2 = _IndexSource("c", np.array(["1", "1.0"]), "keep")
+    fcol = pd.Series([1.0, 1.0], dtype="double[pyarrow]")
+    assert s2._arrow_codes(fcol) is None
+    scol = pd.Series(["1.0", "1"], dtype="str")
+    assert s2.codes(pd.DataFrame({"c": scol})).tolist() == [1.0, 0.0]
