@@ -105,3 +105,56 @@ def test_als_recommendations(spark):
     assert scores == sorted(scores, reverse=True)
     assert model.userFactors.count() == 25
     assert model.itemFactors.count() == 30
+
+
+def test_als_matches_numpy_reference_across_shards(spark):
+    """The sorted-segment + compensated-cumsum fit on the 8-shard mesh
+    must reproduce a dense float64 numpy ALS with identical inits —
+    segments spanning shard boundaries merge via psum, and the
+    double-single prefix keeps per-segment sums exact (r4 rewrite)."""
+    rng = np.random.default_rng(3)
+    n, U, I, r = 40_000, 50, 40, 4
+    pdf = pd.DataFrame({
+        "user": rng.integers(0, U, n),
+        "item": rng.integers(0, I, n),
+        "rating": rng.integers(1, 6, n).astype(float),
+    })
+    df = spark.createDataFrame(pdf)
+    REG = 0.1  # shared by the fit and the numpy reference below
+    model = ALS(userCol="user", itemCol="item", ratingCol="rating",
+                rank=r, maxIter=6, regParam=REG, seed=9).fit(df)
+    # factors in raw-id order (np.unique remaps ids; here ids are dense)
+    uf = np.asarray(model._uf)
+    itf = np.asarray(model._if)
+
+    # independent dense f64 reference with the SAME init draws
+    init = np.random.default_rng(9)
+    uf_ref = (init.standard_normal((U, r)) * 0.1).astype(np.float64)
+    if_ref = (init.standard_normal((I, r)) * 0.1).astype(np.float64)
+    u = pdf["user"].to_numpy()
+    i = pdf["item"].to_numpy()
+    rat = pdf["rating"].to_numpy(np.float64)
+
+    def half(ids, other_rows, n_out):
+        sol = np.zeros((n_out, r))
+        for e in range(n_out):
+            m = ids == e
+            F = other_rows[m]
+            cnt = m.sum()
+            A = F.T @ F + REG * max(cnt, 1) * np.eye(r)
+            b = F.T @ rat[m]
+            if cnt:
+                sol[e] = np.linalg.solve(A, b)
+        return sol
+
+    for _ in range(6):
+        uf_ref = half(u, if_ref[i], U)
+        if_ref = half(i, uf_ref[u], I)
+
+    pred = (uf[u] * itf[i]).sum(1)
+    pred_ref = (uf_ref[u] * if_ref[i]).sum(1)
+    # factors agree to f32-accumulation noise; predictions even tighter
+    np.testing.assert_allclose(pred, pred_ref, rtol=2e-3, atol=2e-3)
+    rmse = float(np.sqrt(np.mean((pred - rat) ** 2)))
+    rmse_ref = float(np.sqrt(np.mean((pred_ref - rat) ** 2)))
+    assert abs(rmse - rmse_ref) < 1e-4
