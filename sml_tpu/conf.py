@@ -57,6 +57,13 @@ _register("sml.applyInPandas.parallelism", 8, int,
 _register("sml.predict.binCacheBytes", 1 << 30, int,
           "LRU byte bound for memoized predict-time binned matrices (CV/"
           "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
+_register("sml.cv.batchFolds", False, _to_bool,
+          "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
+          "map into one vmapped device program for tree regressors. "
+          "Measured SLOWER on a single tunneled chip (the k-fold one-hot "
+          "working set is k x larger and fuses worse, while sequential "
+          "trials already pipeline host prep under device compute); kept "
+          "as an option for meshes where dispatch overhead dominates")
 
 
 class TpuConf:

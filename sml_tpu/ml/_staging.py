@@ -199,6 +199,30 @@ def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
     return hit
 
 
+def stage_stacked_cached(a: np.ndarray) -> jax.Array:
+    """device_put a FOLD-STACKED array (folds, rows, ...) through the
+    content cache, rows (axis 1) sharded over the data axis, fold axis
+    replicated across shards. The caller pre-pads axis 1 to a multiple of
+    the mesh's data dimension. Used by the batched fold×param tree fits."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    a = _normalize(a)
+    key = (_memo_key(a), id(mesh), "stack", n_dev)
+    hit = _stage_cache.get(key)
+    from ..utils.profiler import PROFILER
+    if hit is None:
+        spec = P(None, meshlib.DATA_AXIS, *([None] * (a.ndim - 2)))
+        hit = jax.device_put(a, NamedSharding(mesh, spec))
+        _cache_put(key, hit)
+        PROFILER.count("staging.cache_miss")
+        PROFILER.count("staging.h2d_bytes", a.nbytes)
+    else:
+        PROFILER.count("staging.cache_hit")
+        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
+    return hit
+
+
 def stage_mask_cached(n_padded: int, n_true: int) -> jax.Array:
     mesh = meshlib.get_mesh()
     mkey = (n_padded, n_true, id(mesh), "mask", mesh.shape[meshlib.DATA_AXIS])
@@ -210,7 +234,8 @@ def stage_mask_cached(n_padded: int, n_true: int) -> jax.Array:
     return hit
 
 
-def _route_mesh(hint, arrays, may_promote: bool = True) -> Tuple[object, str]:
+def _route_mesh(hint, arrays, may_promote: bool = True,
+                stacked: bool = False) -> Tuple[object, str]:
     """Stage-aware dispatch: charge the H2D term only for bytes NOT already
     resident on the device mesh, and when the device loses solely because
     of that one-time staging cost, promote the arrays in the background
@@ -243,11 +268,12 @@ def _route_mesh(hint, arrays, may_promote: bool = True) -> Tuple[object, str]:
     n_dev = dev_mesh.shape[meshlib.DATA_AXIS]
     eff = hint
     keyed = []
+    kind = "stack" if stacked else "arr"
     if arrays:
         unstaged = 0.0
         for a in arrays:
             a = _normalize(a)
-            key = (_memo_key(a), id(dev_mesh), "arr", n_dev)
+            key = (_memo_key(a), id(dev_mesh), kind, n_dev)
             if key not in _stage_cache:
                 unstaged += a.nbytes
             keyed.append(a)
@@ -259,20 +285,25 @@ def _route_mesh(hint, arrays, may_promote: bool = True) -> Tuple[object, str]:
     if promote and may_promote and keyed \
             and GLOBAL_CONF.getBool("sml.dispatch.autoPromote"):
         for a in keyed:
-            stage_rows_cached(a)  # async put under the device mesh
+            # async put under the device mesh, in the layout the program
+            # will actually read (probing "arr" keys while the program
+            # stages "stack" layouts would promote dead copies)
+            (stage_stacked_cached if stacked else stage_rows_cached)(a)
     return dispatch.host_mesh(), "host"
 
 
 @contextlib.contextmanager
-def routed_for(hint, *arrays):
+def routed_for(hint, *arrays, stacked: bool = False):
     """Context manager binding the stage-aware dispatch decision as the
     thread's active mesh (see _route_mesh). Also installs the per-thread
-    key memo so the probe's fingerprints are reused by the stage."""
+    key memo so the probe's fingerprints are reused by the stage.
+    `stacked=True` prices/promotes fold-stacked (folds, rows, ...) arrays
+    in their axis-1-sharded layout."""
     had_memo = getattr(_tls_keys, "memo", None)
     if had_memo is None:
         _tls_keys.memo = {}
     try:
-        mesh, _ = _route_mesh(hint, arrays)
+        mesh, _ = _route_mesh(hint, arrays, stacked=stacked)
         with meshlib.use_mesh_local(mesh):
             yield mesh
     finally:

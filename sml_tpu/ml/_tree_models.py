@@ -254,6 +254,48 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
     return _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0, F, mode)
 
 
+def _fit_ensemble_folds(Xs, ys, cats, *, max_depth: int, max_bins: int,
+                        min_instances: int, min_info_gain: float,
+                        n_trees: int, feature_k: Optional[int],
+                        bootstrap: bool, subsample: float, seed: int,
+                        loss: str = "squared") -> List[_EnsembleSpec]:
+    """`_fit_ensemble` for k SAME-SPEC fold datasets in one vmapped device
+    program (`tree_impl.fit_ensembles_folds`): CV's fold fits share every
+    static shape, so one dispatch replaces k. Binning stays per fold (each
+    fold's quantile edges come from ITS rows, matching the sequential
+    path's models exactly in structure)."""
+    from ._staging import routed_for
+    binned_list, binnings, y32s = [], [], []
+    for X, y in zip(Xs, ys):
+        y32 = np.asarray(y, np.float32)
+        binned, binning = _cached_bins(X, y32, max_bins, cats)
+        binned_list.append(binned)
+        binnings.append(binning)
+        y32s.append(y32)
+    F = Xs[0].shape[1]
+    n_total = sum(b.shape[0] for b in binned_list)
+    # stack BEFORE routing so the router prices/promotes the exact
+    # axis-1-sharded arrays the program stages (probing the per-fold 2-D
+    # arrays would discount/promote dead copies)
+    bst, yst, mst = tree_impl.build_fold_stacks(binned_list, y32s)
+    hint = dispatch.WorkHint(
+        flops=2.0 * n_trees * max_depth * n_total * F * max_bins,
+        kind="scatter")
+    with routed_for(hint, bst, yst, mst, stacked=True):
+        spec = TreeSpec(max_depth=max_depth, n_bins=max_bins, n_features=F,
+                        feature_k=feature_k or F, min_instances=min_instances,
+                        min_info_gain=min_info_gain, reg_lambda=0.0,
+                        gamma=0.0)
+        es = tree_impl.EnsembleSpec(
+            tree=spec, n_trees=n_trees, loss=loss, boosting=False,
+            bootstrap=bootstrap and n_trees > 1, subsample=float(subsample),
+            step_size=0.1)
+        results = tree_impl.fit_ensembles_folds(bst, yst, mst, es, seed)
+    mode = "binary" if loss == "logistic" else "regression"
+    return [_EnsembleSpec(trees, max_depth, binnings[k], None, 0.0, F, mode)
+            for k, (trees, base) in enumerate(results)]
+
+
 # ---------------------------------------------------------------------------
 class _TreeModelBase(Model, _TreeParams):
     """Shared transform/persistence for tree ensemble models."""
