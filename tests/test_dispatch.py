@@ -175,3 +175,33 @@ def test_observed_host_rates_steer_routing(tunneled, monkeypatch):
     dispatch.OBSERVED_HOST.observe("traverse", 2e7, 1.0)
     dispatch.OBSERVED_HOST.observe("traverse", 0.0, 1.0)
     assert dispatch.OBSERVED_HOST.rate("traverse") == before
+
+
+def test_route_mesh_stacked_prices_and_promotes_stack_layout(tunneled):
+    """The fold-batched fit consumes axis-1-sharded (folds, rows, ...)
+    stacks: the router must probe and promote THAT layout ("stack" keys),
+    not the per-fold 2-D layout — otherwise residency is discounted for
+    arrays the program never reads and promotion uploads dead copies
+    (r4 review)."""
+    GLOBAL_CONF.set("sml.dispatch.autoPromote", True)
+    stack = np.random.default_rng(1).normal(
+        size=(3, 4096, 32)).astype(np.float32)
+    tunneled.h2d_bw = 1e6
+    hint = WorkHint(flops=5e9, kind="blas")
+    m1, r1 = _staging._route_mesh(hint, (stack,), stacked=True)
+    assert r1 == "host" and dispatch.is_host_mesh(m1)
+    # promotion staged the STACK layout → the stacked probe now sees it
+    m2, r2 = _staging._route_mesh(hint, (stack,), stacked=True)
+    assert r2 == "device" and m2 is meshlib.get_mesh()
+    # the 2-D probe must NOT see the stacked entry as resident (a wrongly
+    # shared key would zero the H2D term and flip this to device)
+    tunneled.h2d_bw = 2.5e5  # make the unstaged H2D decisive for 0.5MB
+    m3, r3 = _staging._route_mesh(hint, (np.ascontiguousarray(stack[0]),),
+                                  may_promote=False)
+    assert r3 == "host"
+    # and the staged stack is row-sharded on axis 1 (fold axis replicated)
+    from sml_tpu.ml._staging import stage_stacked_cached
+    dev = stage_stacked_cached(stack)
+    assert dev.shape == stack.shape
+    spec = dev.sharding.spec
+    assert spec[1] == meshlib.DATA_AXIS and spec[0] is None
