@@ -57,6 +57,10 @@ _register("sml.applyInPandas.parallelism", 8, int,
 _register("sml.predict.binCacheBytes", 1 << 30, int,
           "LRU byte bound for memoized predict-time binned matrices (CV/"
           "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
+_register("sml.fit.foldStackBytes", 1 << 30, int,
+          "Byte bound for the fit-time fold-stack memo (stacked CV fold "
+          "datasets reused across a tuning grid); independent of the "
+          "predict bin cache's budget")
 _register("sml.cv.batchFolds", False, _to_bool,
           "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
           "map into one vmapped device program for tree regressors. "

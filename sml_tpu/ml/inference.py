@@ -41,12 +41,17 @@ def _forest_margin(binned_b, sf, sb, lv, weights, depth: int):
     GATHER-FREE: `table[node]` / take_along_axis lower to XLA's generic
     scratch-memory gather on TPU — a 25-tree/d6 eval at 800k rows ran ~4s
     (r4 profile). Every per-node and per-feature lookup here is a one-hot
-    masked dot instead (the same no-gathers rule the histogram builder
-    follows), which rides the MXU/VPU. The one-hot width grows with the
-    level (2^(l+1)-1 live nodes at level l), so total work is
-    O(rows * n_nodes), not O(rows * n_nodes * depth). Bit-exact vs the
-    gather formulation: every dot has exactly one nonzero term, and all
-    operands are small exact integers in f32."""
+    masked where-SUM (the same pattern as `xbin`), which rides the VPU and
+    is EXACT in f32: each row's sum has exactly one nonzero term, so no
+    accumulation rounding can occur, and — unlike a one-hot matmul — no
+    MXU bf16 operand truncation either (TPU f32 dots round operands to
+    bfloat16; leaf values, tree weights, and feature indices ≥257 are not
+    bf16-exact, which both broke the fused-eval/materialize bit-parity
+    contract and could mis-hit the exact `fiota == fa` select). The
+    per-level `xbin` select scans all F features, so total work is
+    O(rows * (n_nodes + F * depth)); at course-scale F (tens) the n_nodes
+    term dominates, while very wide one-hot feature spaces pay the
+    F*depth term — still far below the gather path's scratch traffic."""
     n_rows = binned_b.shape[0]
     n_feat = binned_b.shape[1]
     n_nodes = sf.shape[1]
@@ -55,26 +60,31 @@ def _forest_margin(binned_b, sf, sb, lv, weights, depth: int):
 
     def one_tree(f, s, v):
         fpos = jnp.maximum(f, 0).astype(jnp.float32)
-        internal = (f >= 0).astype(jnp.float32)
+        internal = f >= 0
         s_f = s.astype(jnp.float32)
         node = jnp.zeros((n_rows,), dtype=jnp.int32)
         for lvl in range(depth):
             width = min(2 ** (lvl + 1) - 1, n_nodes)
             iota = jnp.arange(width, dtype=jnp.int32)
-            ohf = (node[:, None] == iota[None, :]).astype(jnp.float32)
-            fa = ohf @ fpos[:width]        # feature index at current node
-            ba = ohf @ s_f[:width]         # split bin at current node
-            isin = ohf @ internal[:width]  # 1.0 while on an internal node
+            oh = node[:, None] == iota[None, :]
+            fa = jnp.sum(jnp.where(oh, fpos[None, :width], 0.0), axis=1)
+            ba = jnp.sum(jnp.where(oh, s_f[None, :width], 0.0), axis=1)
+            isin = jnp.any(oh & internal[None, :width], axis=1)
             xbin = jnp.sum(jnp.where(fiota[None, :] == fa[:, None],
                                      binned_f, 0.0), axis=1)
             child = 2 * node + 1 + (xbin > ba).astype(jnp.int32)
-            node = jnp.where(isin > 0.5, child, node)
+            node = jnp.where(isin, child, node)
         leaf_oh = (node[:, None]
                    == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
-        return leaf_oh.astype(jnp.float32) @ v.astype(jnp.float32)
+        return jnp.sum(jnp.where(leaf_oh, v.astype(jnp.float32)[None, :],
+                                 0.0), axis=1)
 
     per_tree = jax.vmap(one_tree)(sf, sb, lv)          # (T, rows/chip)
-    return jnp.tensordot(weights, per_tree, axes=1)
+    # weighted tree sum as an elementwise reduce: operands stay exact f32
+    # (no MXU bf16 rounding); the T-term accumulation order is
+    # XLA-determined, so the final sum is f32-accurate but not
+    # bit-ordered like the host path's sequential loop
+    return jnp.sum(weights.astype(jnp.float32)[:, None] * per_tree, axis=0)
 
 
 def _make_forest_forward(depth: int):

@@ -445,6 +445,7 @@ def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
 
 _folds_cache: Dict[tuple, object] = {}
 _stack_memo: Dict[tuple, tuple] = {}
+_stack_memo_lock = _threading.Lock()  # tuning trials stack concurrently
 
 
 def build_fold_stacks(binned_list, y_list):
@@ -460,21 +461,36 @@ def build_fold_stacks(binned_list, y_list):
                 for b in binned_list)
     key = (tuple(id(b) for b in binned_list),
            tuple(id(y) for y in y_list), n_pad)
-    hit = _stack_memo.get(key)
-    if hit is not None:
-        return hit[2]
-    fo = len(binned_list)
-    F = binned_list[0].shape[1]
-    bst = np.zeros((fo, n_pad, F), dtype=binned_list[0].dtype)
-    yst = np.zeros((fo, n_pad), dtype=np.float32)
-    mst = np.zeros((fo, n_pad), dtype=np.float32)
-    for k, (b, y) in enumerate(zip(binned_list, y_list)):
-        bst[k, :b.shape[0]] = b
-        yst[k, :len(y)] = y
-        mst[k, :len(y)] = 1.0
-    while len(_stack_memo) >= 4:
-        _stack_memo.pop(next(iter(_stack_memo)))
-    _stack_memo[key] = (list(binned_list), list(y_list), (bst, yst, mst))
+    # build under the lock: concurrent tuning trials share the key, and a
+    # double-checked miss would have each thread allocate its own multi-GB
+    # stack (transient 2x memory spike); the loser waits and hits instead
+    with _stack_memo_lock:
+        hit = _stack_memo.get(key)
+        if hit is not None:
+            return hit[2]
+        fo = len(binned_list)
+        F = binned_list[0].shape[1]
+        bst = np.zeros((fo, n_pad, F), dtype=binned_list[0].dtype)
+        yst = np.zeros((fo, n_pad), dtype=np.float32)
+        mst = np.zeros((fo, n_pad), dtype=np.float32)
+        for k, (b, y) in enumerate(zip(binned_list, y_list)):
+            bst[k, :b.shape[0]] = b
+            yst[k, :len(y)] = y
+            mst[k, :len(y)] = 1.0
+        # bytes-bounded like the predict bin cache (a count-only bound
+        # pinned multi-GB fold stacks for the process lifetime on large CV
+        # datasets). The NEWEST stack is always cached — the active grid
+        # reuses it per parameter map, so the build-once promise must hold
+        # even when one stack alone exceeds the budget; the bound trims
+        # OLDER entries, capping steady-state memory at ~one active stack.
+        new_bytes = bst.nbytes + yst.nbytes + mst.nbytes
+        from ..conf import GLOBAL_CONF as _conf
+        max_bytes = _conf.getInt("sml.fit.foldStackBytes")
+        total = new_bytes + sum(e[3] for e in _stack_memo.values())
+        while _stack_memo and (len(_stack_memo) >= 2 or total > max_bytes):
+            total -= _stack_memo.pop(next(iter(_stack_memo)))[3]
+        _stack_memo[key] = (list(binned_list), list(y_list),
+                            (bst, yst, mst), new_bytes)
     return bst, yst, mst
 
 

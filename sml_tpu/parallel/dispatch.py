@@ -94,14 +94,23 @@ class _ObservedRates:
       evals bouncing to a host path that cost ~1.4s each;
     - throughput weighting makes big calls dominate the estimate in
       proportion to the work they did, which is what routing big calls
-      needs, while the flops floor keeps tiny-call noise out entirely."""
+      needs, while the flops floor keeps tiny-call noise out entirely.
+
+    Observations AGE OUT (`_MAX_AGE_S`): routing by observed rates is
+    otherwise a one-way ratchet — once one contended/throttled window
+    flips a kind's routing to the device, no further host samples are
+    ever taken for that kind and the stale slow rate persists until
+    process restart. Stale entries fall out of the window, and an empty
+    window falls back to the bootstrap constant, so the host gets
+    re-probed after recovery."""
 
     _WINDOW = 8
     _MIN_FLOPS = 1e8  # below this, per-call overhead ≈ the signal
+    _MAX_AGE_S = 120.0  # contention windows are transient at this scale
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._recent: dict = {}  # kind -> deque of (flops, seconds)
+        self._recent: dict = {}  # kind -> deque of (flops, seconds, t)
 
     def observe(self, kind: str, flops: float, seconds: float) -> None:
         # sub-ms timings are dominated by timer noise / python overhead
@@ -112,14 +121,18 @@ class _ObservedRates:
             dq = self._recent.get(kind)
             if dq is None:
                 dq = self._recent[kind] = deque(maxlen=self._WINDOW)
-            dq.append((flops, seconds))
+            dq.append((flops, seconds, time.monotonic()))
 
     def rate(self, kind: str):
+        cutoff = time.monotonic() - self._MAX_AGE_S
         with self._lock:
             dq = self._recent.get(kind)
+            if dq:
+                while dq and dq[0][2] < cutoff:
+                    dq.popleft()
             if not dq:
                 return None
-            return sum(f for f, _ in dq) / sum(s for _, s in dq)
+            return sum(f for f, _, _ in dq) / sum(s for _, s, _ in dq)
 
 
 OBSERVED_HOST = _ObservedRates()
