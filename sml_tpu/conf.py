@@ -57,6 +57,13 @@ _register("sml.applyInPandas.parallelism", 8, int,
 _register("sml.predict.binCacheBytes", 1 << 30, int,
           "LRU byte bound for memoized predict-time binned matrices (CV/"
           "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
+_register("sml.shuffle.reuseBytes", 1 << 30, int,
+          "Byte bound for the shuffle-reuse cache (memoized applyInPandas "
+          "group splits of cached frames); 0 disables reuse")
+_register("sml.linear.compactBytes", 1 << 28, int,
+          "Expanded-block size (n*d*4) above which linear/logistic fits "
+          "stage the compact numeric+code form and expand one-hot slots "
+          "on-chip instead of materializing the (n, d) matrix")
 _register("sml.fit.foldStackBytes", 1 << 30, int,
           "Byte bound for the fit-time fold-stack memo (stacked CV fold "
           "datasets reused across a tuning grid); independent of the "

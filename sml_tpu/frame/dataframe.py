@@ -633,6 +633,9 @@ class DataFrame:
         if self._compute is not None:
             self._parts = None
             self._offsets = None
+        if self._pdf_cache is not None:
+            from .grouped import drop_split_cache_for
+            drop_split_cache_for(self._pdf_cache)
         self._pdf_cache = None
         return self
 

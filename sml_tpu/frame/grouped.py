@@ -21,6 +21,56 @@ from .dataframe import DataFrame, _concat, _hash_repartition, coerce_to_schema
 from .types import StructType, parse_schema
 
 
+import threading as _threading
+
+# SHUFFLE REUSE (SURVEY L1): Spark reuses shuffle files when the same
+# stage re-executes over unchanged lineage; here the per-key group split
+# of a cached frame is the shuffle output, memoized by the identity of
+# the frame's memoized concat (id-stable for cached frames, held strongly
+# so the id cannot be recycled). Entries: (token, groups, bytes);
+# byte-bounded LRU (sml.shuffle.reuseBytes) — a split pins a full copy of
+# its dataset, so a count-only bound would hold multi-GB frames for the
+# process lifetime. `DataFrame.unpersist` drops matching entries.
+# Handed-out groups are CoW shallow copies, so a fn that mutates its
+# input cannot pollute the cache (pandas>=3 copy-on-write is always on;
+# under an older pandas with CoW disabled the handout deep-copies, the
+# same defense DataFrame.toPandas applies).
+_split_cache: Dict[tuple, tuple] = {}
+_split_lock = _threading.Lock()
+
+
+def _split_cache_put(ckey, token, groups) -> None:
+    nbytes = int(sum(int(g.memory_usage(deep=False).sum()) for g in groups))
+    max_bytes = GLOBAL_CONF.getInt("sml.shuffle.reuseBytes")
+    if nbytes > max_bytes:
+        return
+    with _split_lock:
+        _split_cache[ckey] = (token, groups, nbytes)
+        total = sum(e[2] for e in _split_cache.values())
+        while len(_split_cache) > 1 and total > max_bytes:
+            total -= _split_cache.pop(next(iter(_split_cache)))[2]
+
+
+def drop_split_cache_for(token) -> None:
+    """Invalidate shuffle-reuse entries for a frame's memoized concat
+    (DataFrame.unpersist calls this so dropping a cached frame actually
+    releases the split's memory)."""
+    if token is None:
+        return
+    with _split_lock:
+        for k in [k for k, v in _split_cache.items() if v[0] is token]:
+            _split_cache.pop(k)
+
+
+def _group_handout(g: pd.DataFrame) -> pd.DataFrame:
+    """The frame a user fn receives: shallow under CoW (writes can't reach
+    the cached split), deep when someone disabled CoW on an older pandas."""
+    if int(pd.__version__.split(".")[0]) < 3 \
+            and pd.options.mode.copy_on_write is not True:
+        return g.copy(deep=True)
+    return g.copy(deep=False)
+
+
 class GroupedData:
     def __init__(self, df: DataFrame, keys: List[Column]):
         self._df = df
@@ -29,13 +79,18 @@ class GroupedData:
     def _grouped(self):
         # toPandas, not a fresh concat: the frame memoizes its concat, so
         # repeated grouped actions on a cached frame share one materialization
-        pdf = self._df.toPandas() if hasattr(self._df, "toPandas") \
-            else _concat(self._df._materialize())
+        if hasattr(self._df, "toPandas"):
+            pdf = self._df.toPandas()
+            token = self._df.__dict__.get("_pdf_cache")
+        else:
+            pdf = _concat(self._df._materialize())
+            token = None
         key_names = [k._name for k in self._keys]
         for k in self._keys:
             if k._name not in pdf.columns:
                 pdf[k._name] = k._eval(pdf, EvalContext()).values
-        return pdf, key_names
+                token = None  # computed key: beyond the memoized concat
+        return pdf, key_names, token
 
     def agg(self, *exprs) -> DataFrame:
         if len(exprs) == 1 and isinstance(exprs[0], dict):
@@ -48,7 +103,7 @@ class GroupedData:
         parent = self
 
         def compute():
-            pdf, key_names = parent._grouped()
+            pdf, key_names, _ = parent._grouped()
             results: Dict[str, pd.Series] = {}
             if key_names:
                 gb_index = pdf.groupby(key_names, sort=False, dropna=False)
@@ -120,31 +175,69 @@ class GroupedData:
         parent = self
 
         def compute():
-            pdf, key_names = parent._grouped()
+            pdf, key_names, token = parent._grouped()
             if len(pdf) == 0:
                 return [coerce_to_schema(pd.DataFrame(), sch)]
-            gb = pdf.groupby(key_names, sort=False, dropna=False)
+            ckey = ((id(token), tuple(key_names))
+                    if token is not None else None)
+            groups = None
+            if ckey is not None:
+                with _split_lock:
+                    hit = _split_cache.get(ckey)
+                # `is` check: the strong ref in the entry keeps the id
+                # valid, but a rebuilt concat for the same frame must miss
+                if hit is not None and hit[0] is token:
+                    groups = hit[1]
             par = GLOBAL_CONF.getInt("sml.applyInPandas.parallelism")
-            if gb.ngroups > 1 and par > 1:
-                # per-group fns run concurrently, as on Spark executors
-                # (P8): sklearn/numpy payloads release the GIL in BLAS.
-                # Groups are SUBMITTED as the groupby iterator yields them,
-                # so worker fns overlap with the remaining group extraction
-                # (the per-group take of a wide object-column frame is the
-                # expensive half of the split).
-                # NOTE these are threads of ONE interpreter — a fn that
-                # mutates shared closure state needs
-                # sml.applyInPandas.parallelism=1 (Spark's process-isolated
-                # workers could never share state in the first place)
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(
-                        max_workers=min(par, gb.ngroups)) as ex:
-                    futs = [ex.submit(fn, g.reset_index(drop=True))
-                            for _, g in gb]
-                    outs = [coerce_to_schema(f.result(), sch) for f in futs]
+            from concurrent.futures import ThreadPoolExecutor
+            if groups is not None:
+                # shuffle reuse: the split is already materialized — the
+                # leg is pure fn execution, fanned across workers
+                if len(groups) > 1 and par > 1:
+                    with ThreadPoolExecutor(
+                            max_workers=min(par, len(groups))) as ex:
+                        futs = [ex.submit(fn, _group_handout(g))
+                                for g in groups]
+                        outs = [coerce_to_schema(f.result(), sch)
+                                for f in futs]
+                else:
+                    outs = [coerce_to_schema(fn(_group_handout(g)), sch)
+                            for g in groups]
             else:
-                outs = [coerce_to_schema(fn(g.reset_index(drop=True)), sch)
-                        for _, g in gb]
+                gb = pdf.groupby(key_names, sort=False, dropna=False)
+                collected = []
+
+                def split():
+                    for _, g in gb:
+                        g = g.reset_index(drop=True)
+                        if ckey is not None:  # else: never cached — don't
+                            collected.append(g)  # pin a dataset copy
+                        yield g
+
+                if gb.ngroups > 1 and par > 1:
+                    # per-group fns run concurrently, as on Spark executors
+                    # (P8): sklearn/numpy payloads release the GIL in BLAS.
+                    # Groups are SUBMITTED as the groupby iterator yields
+                    # them, so worker fns overlap with the remaining group
+                    # extraction (the per-group take of a wide
+                    # object-column frame is the expensive half of the
+                    # split).
+                    # NOTE these are threads of ONE interpreter — a fn that
+                    # mutates shared closure state needs
+                    # sml.applyInPandas.parallelism=1 (Spark's
+                    # process-isolated workers could never share state in
+                    # the first place)
+                    with ThreadPoolExecutor(
+                            max_workers=min(par, gb.ngroups)) as ex:
+                        futs = [ex.submit(fn, _group_handout(g))
+                                for g in split()]
+                        outs = [coerce_to_schema(f.result(), sch)
+                                for f in futs]
+                else:
+                    outs = [coerce_to_schema(fn(_group_handout(g)), sch)
+                            for g in split()]
+                if ckey is not None:
+                    _split_cache_put(ckey, token, collected)
             full = pd.concat(outs, ignore_index=True)
             nparts = min(len(outs), GLOBAL_CONF.getInt("sml.shuffle.partitions"))
             avail = [k for k in key_names if k in full.columns]

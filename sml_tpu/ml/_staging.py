@@ -74,6 +74,34 @@ def extract_xy(df, featuresCol: str, labelCol: str,
     return X, y, w
 
 
+def extract_compact(df, featuresCol: str, labelCol: str):
+    """(CompactParts, y) when the frame carries a compact featurized block
+    (attached by base.Pipeline's fused fit for huge linear fits — see
+    featurizer.CompactParts), else None. Labels come from the raw pandas
+    with the featurizer's row-drop mask and the finite-label filter
+    applied to BOTH sides, matching extract_xy's semantics."""
+    feat = getattr(df, "_featurized_compact", None)
+    if feat is None or featuresCol not in feat:
+        return None
+    parts, raw_pdf = feat[featuresCol]
+    y = np.asarray(raw_pdf[labelCol], dtype=np.float32)
+    if parts.keep is not None:
+        y = y[parts.keep]
+    ok = np.isfinite(y)
+    if not ok.all():
+        # compose the raw-row mask so parts.keep keeps describing the
+        # surviving rows of the RAW frame (its documented contract)
+        if parts.keep is not None:
+            keep = parts.keep.copy()
+            keep[keep] = ok
+        else:
+            keep = ok
+        parts = parts._replace(num=parts.num[ok], codes=parts.codes[ok],
+                               keep=keep)
+        y = y[ok]
+    return parts, y
+
+
 import threading as _threading
 
 _stage_cache: "dict" = {}

@@ -17,7 +17,7 @@ import pandas as pd
 from .base import Estimator, Model, load_arrays, save_arrays
 from .feature import _as_object_series
 from .linalg import DenseVector, vector_series
-from ._staging import extract_features, extract_xy
+from ._staging import extract_compact, extract_features, extract_xy
 from . import linear_impl
 from ._tree_models import (DecisionTreeClassificationModel,
                            DecisionTreeClassifier, GBTClassificationModel,
@@ -26,10 +26,31 @@ from ._tree_models import (DecisionTreeClassificationModel,
 
 
 class BinaryLogisticRegressionSummary:
-    def __init__(self, accuracy: float, areaUnderROC: float, numInstances: int):
-        self.accuracy = accuracy
-        self.areaUnderROC = areaUnderROC
+    """Training summary; metrics materialize on first read when built
+    lazily (an 8M-row accuracy/AUC pass costs ~2s the caller may never
+    ask for)."""
+
+    def __init__(self, accuracy: float = None, areaUnderROC: float = None,
+                 numInstances: int = 0, lazy_fn=None):
+        self._accuracy = accuracy
+        self._auc = areaUnderROC
         self.numInstances = numInstances
+        self._lazy_fn = lazy_fn
+
+    def _force(self):
+        if self._accuracy is None and self._lazy_fn is not None:
+            self._accuracy, self._auc = self._lazy_fn()
+            self._lazy_fn = None
+
+    @property
+    def accuracy(self) -> float:
+        self._force()
+        return self._accuracy
+
+    @property
+    def areaUnderROC(self) -> float:
+        self._force()
+        return self._auc
 
 
 class LogisticRegression(Estimator):
@@ -62,21 +83,50 @@ class LogisticRegression(Estimator):
         return self._set(featuresCol=v)
 
     def _fit(self, df) -> "LogisticRegressionModel":
-        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
-                             self.getOrDefault("labelCol"))
-        ok = np.isfinite(y)
-        X, y = X[ok], y[ok]
-        res = linear_impl.fit_logistic(
-            X, y,
-            regParam=float(self.getOrDefault("regParam")),
-            elasticNetParam=float(self.getOrDefault("elasticNetParam")),
-            fitIntercept=bool(self.getOrDefault("fitIntercept")),
-            maxIter=int(self.getOrDefault("maxIter")),
-            tol=float(self.getOrDefault("tol")))
+        lam = float(self.getOrDefault("regParam"))
+        maxIter = int(self.getOrDefault("maxIter"))
+        tol = float(self.getOrDefault("tol"))
+        fit_int = bool(self.getOrDefault("fitIntercept"))
+        compact = extract_compact(df, self.getOrDefault("featuresCol"),
+                                  self.getOrDefault("labelCol"))
+        if compact is not None and lam == 0.0 and fit_int:
+            # fused-IRLS device program: the whole Newton loop in one
+            # dispatch, one-hot slots expanded on-chip (linear_impl)
+            parts, y = compact
+            res = linear_impl.fit_logistic_compact(parts, y,
+                                                   maxIter=maxIter, tol=tol)
+            model = LogisticRegressionModel(coefficients=res.coefficients,
+                                            intercept=res.intercept)
+            model._inherit_params(self)
+
+            def lazy_metrics(parts=parts, y=y, res=res):
+                margin = parts.predict_affine(res.coefficients,
+                                              res.intercept)
+                pred = (margin > 0).astype(float)
+                return float(np.mean(pred == y)), _fast_auc(margin, y)
+
+            model._summary = BinaryLogisticRegressionSummary(
+                numInstances=len(y), lazy_fn=lazy_metrics)
+            return model
+        else:
+            if compact is not None:
+                # penalized config needs the materialized block (prox on
+                # raw coefficients); expand host-side and take the loop
+                parts, y = compact
+                X = parts.expand_host()
+            else:
+                X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
+                                     self.getOrDefault("labelCol"))
+                ok = np.isfinite(y)
+                X, y = X[ok], y[ok]
+            res = linear_impl.fit_logistic(
+                X, y, regParam=lam,
+                elasticNetParam=float(self.getOrDefault("elasticNetParam")),
+                fitIntercept=fit_int, maxIter=maxIter, tol=tol)
+            margin = X @ res.coefficients + res.intercept
         model = LogisticRegressionModel(coefficients=res.coefficients,
                                         intercept=res.intercept)
         model._inherit_params(self)
-        margin = X @ res.coefficients + res.intercept
         pred = (margin > 0).astype(float)
         model._summary = BinaryLogisticRegressionSummary(
             accuracy=float(np.mean(pred == y)),

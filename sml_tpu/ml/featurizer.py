@@ -21,10 +21,73 @@ VectorAssembler(handleInvalid in ("error", "keep")).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 import pandas as pd
+
+
+class CompactParts(NamedTuple):
+    """Compact pre-expansion form of a numeric+one-hot feature block.
+
+    The expanded (n, d) one-hot matrix never materializes: `num` holds the
+    plain numeric slots, `codes` the integer category codes, and `layout`
+    records the assembler's slot order as ("num", num_col) / ("oh",
+    code_col, width) entries. The device programs expand one-hots ON CHIP
+    (`linear_impl._expand_masked`) — staging ships n*(p+k) words instead
+    of n*d, a ~6x H2D cut at the course's schema and the difference
+    between feasible and impossible at 8M+ rows over a ~1.3 GB/s tunnel.
+    """
+    num: np.ndarray                 # (n, p) float32 numeric slots
+    codes: np.ndarray               # (n, k) int32 category codes
+    layout: tuple                   # slot-order expansion recipe
+    width: int                      # expanded feature count d
+    keep: Optional[np.ndarray]      # row-keep mask (indexer "skip" drops)
+
+    def expand_host(self) -> np.ndarray:
+        """(n, d) float32 — the exact block the generic featurizer would
+        build; the memory-heavy fallback for paths that need X itself."""
+        n = self.num.shape[0]
+        out = np.zeros((n, self.width), dtype=np.float32)
+        lo = 0
+        for item in self.layout:
+            if item[0] == "num":
+                out[:, lo] = self.num[:, item[1]]
+                lo += 1
+            else:
+                _, j, width = item
+                idx = self.codes[:, j]
+                ok = (idx >= 0) & (idx < width)
+                rows = np.nonzero(ok)[0]
+                out[rows, lo + idx[rows].astype(np.intp)] = 1.0
+                lo += width
+        return out
+
+    def predict_affine(self, coef: np.ndarray, intercept: float) -> np.ndarray:
+        """X @ coef + intercept without expanding: numeric dot + one
+        embedding-table lookup per encoded column (w·onehot(i) == w[i])."""
+        coef = np.asarray(coef, dtype=np.float64)
+        acc = np.full(self.num.shape[0], float(intercept), dtype=np.float64)
+        lo = 0
+        num_cols, num_w = [], []
+        for item in self.layout:
+            if item[0] == "num":
+                num_cols.append(item[1])
+                num_w.append(coef[lo])
+                lo += 1
+            else:
+                _, j, width = item
+                idx = self.codes[:, j]
+                table = coef[lo:lo + width]
+                ok = (idx >= 0) & (idx < width)
+                contrib = np.zeros(len(idx), dtype=np.float64)
+                contrib[ok] = table[idx[ok].astype(np.intp)]
+                acc += contrib
+                lo += width
+        if num_cols:
+            acc += self.num[:, num_cols].astype(np.float64) \
+                @ np.asarray(num_w)
+        return acc
 
 
 def _numeric(col) -> np.ndarray:
@@ -302,6 +365,54 @@ class CompiledFeaturizer:
     def __call__(self, pdf: pd.DataFrame) -> np.ndarray:
         return self.transform_with_mask(pdf)[0]
 
+    def compact_parts(self, pdf: pd.DataFrame) -> Optional[CompactParts]:
+        """Extract the block in compact form (see CompactParts) when every
+        source is numeric or one-hot-of-index — the standard course chain.
+        Returns None (caller keeps the materialized path) for any other
+        source shape, or when a value the expanded block would carry as
+        NaN appears (the generic path's NaN semantics — error raises,
+        NaN-poisoned fits — are not worth duplicating on the fast path)."""
+        n = len(pdf)
+        drop = np.zeros(n, dtype=bool)
+        layout: List[tuple] = []
+        num_srcs: List[_NumericSource] = []
+        code_cols: List[np.ndarray] = []
+        for s in self.sources:
+            if type(s) is _NumericSource:
+                layout.append(("num", len(num_srcs)))
+                num_srcs.append(s)
+            elif isinstance(s, _OneHotSource):
+                if isinstance(s.inner, _IndexSource):
+                    c = s.inner.resolve(pdf, drop)
+                else:
+                    c = _numeric(pdf[s.inner.col])
+                    if s.inner.fill is not None:
+                        c = np.where(np.isfinite(c), c, s.inner.fill)
+                if not np.isfinite(c).all():
+                    return None  # NaN one-hot row: generic-path semantics
+                layout.append(("oh", len(code_cols), s.width))
+                code_cols.append(c.astype(np.int32))
+            else:
+                return None
+        if num_srcs:
+            fills = np.asarray([np.nan if s.fill is None else s.fill
+                                for s in num_srcs])
+            num = extract_numeric_block(
+                pdf, [s.col for s in num_srcs], fills).astype(np.float32)
+            if not np.isfinite(num).all():
+                return None  # NaN feature: generic path raises/poisons
+        else:
+            num = np.zeros((n, 0), dtype=np.float32)
+        codes = (np.stack(code_cols, axis=1) if code_cols
+                 else np.zeros((n, 0), dtype=np.int32))
+        keep = None
+        if drop.any():
+            keep = ~drop
+            num, codes = num[keep], codes[keep]
+        return CompactParts(np.ascontiguousarray(num),
+                            np.ascontiguousarray(codes),
+                            tuple(layout), self.width, keep)
+
     def _slot_map(self) -> dict:
         """assembler input position by source id: id(source) → (lo, width)."""
         m, lo = {}, 0
@@ -500,6 +611,23 @@ def _try_fast_fit(stages, raw_pdf, make_frame):
         else:
             pos += 1
     out_col = assembler.getOrDefault("outputCol")
+
+    # huge linear fits skip X entirely: the compact block stages n*(p+k)
+    # words and expands one-hots on-chip (CompactParts; the 8M-row scale
+    # path). Gated by size so course-scale fits keep the materialized
+    # block and its golden-pinned numerics bit-for-bit.
+    if type(est).__name__ in ("LinearRegression", "LogisticRegression"):
+        from ..conf import GLOBAL_CONF
+        if len(raw_pdf) * feat.width * 4 \
+                >= GLOBAL_CONF.getInt("sml.linear.compactBytes"):
+            parts = feat.compact_parts(raw_pdf)
+            if parts is not None:
+                shim = make_frame(raw_pdf)
+                shim._ml_attrs = dict(attrs)
+                shim._ml_attrs[out_col] = {"slots": slots,
+                                           "numFeatures": pos}
+                shim._featurized_compact = {out_col: (parts, raw_pdf)}
+                return fitted, shim
 
     X, keep = feat.transform_with_mask(raw_pdf)
     shim = make_frame(raw_pdf)

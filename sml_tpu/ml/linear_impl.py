@@ -83,8 +83,20 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     """Least squares with (optional) elastic-net penalty on the Gram
     sufficient statistics. Matches MLlib semantics: the penalty applies to
     standardized coefficients; the intercept is never penalized."""
-    n, d = X.shape
+    d = X.shape[1]
     A, b, n_f, yy = gram_stats(X, y)
+    return _solve_gram(A, b, n_f, yy, d, regParam=regParam,
+                       elasticNetParam=elasticNetParam,
+                       fitIntercept=fitIntercept,
+                       standardization=standardization,
+                       maxIter=maxIter, tol=tol)
+
+
+def _solve_gram(A, b, n_f, yy, d, *, regParam, elasticNetParam,
+                fitIntercept, standardization, maxIter, tol) -> LinearFit:
+    """Every least-squares variant from the (d+1)² Gram moments — shared
+    by the materialized and compact front ends (the algebra must live in
+    exactly one place)."""
     # moments from the Gram pass (last row/col hold the sums)
     sx = A[-1, :d] / n_f
     sy = b[-1] / n_f
@@ -146,6 +158,155 @@ def fit_linear(X: np.ndarray, y: np.ndarray, *, regParam: float = 0.0,
     intercept = float(sy - sx @ w) if fitIntercept else 0.0
     w_full = np.concatenate([w, [intercept]])
     return LinearFit(w, intercept, maxIter, _fit_stats(A, b, n_f, yy, w_full))
+
+
+# --------------------------------------------- compact (expand-on-device)
+def _expand_masked(num_b, codes_b, mask, layout):
+    """Per-chip expansion of a CompactParts block into [X 1], rows masked.
+
+    One-hot pieces are `code == iota` compares on the VPU — the (n, d)
+    block exists only in HBM on the chip, never on the host or the tunnel
+    (featurizer.CompactParts). Out-of-range codes (handleInvalid="keep"
+    overflow slots) yield all-zero rows exactly like the host writer.
+    Padding rows carry code 0, so EVERY piece is mask-multiplied."""
+    pieces = []
+    for item in layout:
+        if item[0] == "num":
+            pieces.append(num_b[:, item[1]][:, None])
+        else:
+            _, j, width = item
+            iota = jnp.arange(width, dtype=codes_b.dtype)
+            pieces.append((codes_b[:, j][:, None]
+                           == iota[None, :]).astype(jnp.float32))
+    pieces.append(jnp.ones((num_b.shape[0], 1), dtype=jnp.float32))
+    return jnp.concatenate(pieces, axis=1) * mask[:, None]
+
+
+_compact_gram_fns: dict = {}
+
+
+def _compact_gram_fn(layout):
+    fn = _compact_gram_fns.get(layout)
+    if fn is not None:
+        return fn
+
+    def gram_compact(num_b, codes_b, yb, mask):
+        # f32 matmul precision: bf16 operand truncation would corrupt the
+        # Gram moments (counts up to n and squared sums are not bf16-exact)
+        with jax.default_matmul_precision("float32"):
+            Xa = _expand_masked(num_b, codes_b, mask, layout)
+            yb = yb * mask
+            A = coll.psum(Xa.T @ Xa)
+            b = coll.psum(Xa.T @ yb)
+            n = coll.psum(jnp.sum(mask))
+            yy = coll.psum(jnp.sum(yb * yb))
+        return A, b, n, yy
+
+    gram_compact.__name__ = f"gram_compact_{abs(hash(layout)) % 99991}"
+    _compact_gram_fns[layout] = gram_compact
+    return gram_compact
+
+
+def gram_stats_compact(parts, y: np.ndarray):
+    """gram_stats over a featurizer.CompactParts block: one device pass,
+    one-hot slots expanded on-chip (SURVEY §2.2 P2 at beyond-one-machine
+    scale — `SML/ML 00b - Spark Review.py:84`)."""
+    n_rows = parts.num.shape[0]
+    d = parts.width
+    A, b, n, yy = run_data_parallel(
+        _compact_gram_fn(parts.layout), parts.num, parts.codes,
+        np.asarray(y, np.float32),
+        work=WorkHint(flops=2.0 * n_rows * (d + 1) ** 2, kind="blas"))
+    return (np.asarray(A, dtype=np.float64), np.asarray(b, dtype=np.float64),
+            float(n), float(yy))
+
+
+def fit_linear_compact(parts, y: np.ndarray, *, regParam: float = 0.0,
+                       elasticNetParam: float = 0.0,
+                       fitIntercept: bool = True,
+                       standardization: bool = True, maxIter: int = 100,
+                       tol: float = 1e-6) -> LinearFit:
+    """fit_linear without ever materializing the one-hot block: the Gram
+    moments come from the on-device expansion, everything downstream is
+    the same host algebra (_solve_gram). Supports every penalty config —
+    elastic net runs on the Gram, not the data."""
+    A, b, n_f, yy = gram_stats_compact(parts, y)
+    return _solve_gram(A, b, n_f, yy, parts.width, regParam=regParam,
+                       elasticNetParam=elasticNetParam,
+                       fitIntercept=fitIntercept,
+                       standardization=standardization,
+                       maxIter=maxIter, tol=tol)
+
+
+_compact_irls_fns: dict = {}
+
+
+def _compact_irls_fn(layout, maxIter: int, tol: float):
+    key = (layout, maxIter, float(tol))
+    fn = _compact_irls_fns.get(key)
+    if fn is not None:
+        return fn
+
+    def irls_compact(num_b, codes_b, yb, mask):
+        """WHOLE-FIT fused IRLS: the expanded block stays resident in HBM
+        and all maxIter Newton steps — grad/Hessian psum, (d+1)² solve,
+        damping, convergence freeze — run in ONE dispatch. The host loop
+        pays the tunnel's ~70-110ms fixed latency per iteration; at
+        course-scale d that latency IS the fit time. Semantics mirror
+        fit_logistic's lam=0 loop: step = solve(H + 1e-8 I, g), damp to
+        the midpoint when the log-likelihood drops by >1e3, freeze after
+        max|Δw| < tol (executed iterations are reported)."""
+        with jax.default_matmul_precision("float32"):
+            Xa = _expand_masked(num_b, codes_b, mask, layout)
+            d1 = Xa.shape[1]
+            eye = jnp.eye(d1, dtype=jnp.float32)
+
+            def body(carry, _):
+                w, prev_ll, done, iters = carry
+                eta = Xa @ w
+                p = jax.nn.sigmoid(eta)
+                Wd = jnp.maximum(p * (1 - p), 1e-6) * mask
+                grad = coll.psum(Xa.T @ ((p - yb) * mask))
+                hess = coll.psum((Xa * Wd[:, None]).T @ Xa)
+                ll = coll.psum(jnp.sum(mask * (
+                    yb * jax.nn.log_sigmoid(eta)
+                    + (1 - yb) * jax.nn.log_sigmoid(-eta))))
+                step = jnp.linalg.solve(hess + 1e-8 * eye, grad)
+                w_new = w - step
+                conv = jnp.max(jnp.abs(w_new - w)) < tol
+                damp = ll < prev_ll - 1e3
+                w_next = jnp.where(done, w,
+                                   jnp.where(damp, (w + w_new) / 2, w_new))
+                iters = iters + jnp.where(done, 0, 1)
+                return (w_next, jnp.where(done, prev_ll, ll),
+                        done | conv, iters), None
+
+            init = (jnp.zeros((d1,), jnp.float32), jnp.float32(-jnp.inf),
+                    jnp.bool_(False), jnp.int32(0))
+            (w, _, _, iters), _ = jax.lax.scan(body, init, None,
+                                               length=maxIter)
+        return w, iters
+
+    irls_compact.__name__ = \
+        f"irls_compact_{abs(hash(key)) % 99991}"
+    _compact_irls_fns[key] = irls_compact
+    return irls_compact
+
+
+def fit_logistic_compact(parts, y: np.ndarray, *, maxIter: int = 100,
+                         tol: float = 1e-7) -> LinearFit:
+    """Unpenalized binomial logistic fit over a CompactParts block — the
+    fused-IRLS device program (see _compact_irls_fn). Penalized configs
+    need the materialized block (prox shrinkage on raw coefficients);
+    callers route those through parts.expand_host() + fit_logistic."""
+    n_rows, d = parts.num.shape[0], parts.width
+    w, iters = run_data_parallel(
+        _compact_irls_fn(parts.layout, int(maxIter), float(tol)),
+        parts.num, parts.codes, np.asarray(y, np.float32),
+        work=WorkHint(flops=3.0 * maxIter * n_rows * (d + 1) ** 2,
+                      kind="blas"))
+    w = np.asarray(w, dtype=np.float64)
+    return LinearFit(w[:d], float(w[d]), int(iters))
 
 
 def _newton_pass(Xb, yb, mask, wb):

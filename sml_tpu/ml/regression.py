@@ -17,7 +17,7 @@ import pandas as pd
 from .base import Estimator, Model, load_arrays, save_arrays
 from .feature import _as_object_series
 from .linalg import DenseVector
-from ._staging import extract_features, extract_xy
+from ._staging import extract_compact, extract_features, extract_xy
 from . import linear_impl
 from ._tree_models import (DecisionTreeRegressionModel, DecisionTreeRegressor,
                            GBTRegressionModel, GBTRegressor,
@@ -84,18 +84,27 @@ class LinearRegression(Estimator, _PredictorParams):
     def _fit(self, df) -> "LinearRegressionModel":
         # pass the FRAME, not a pandas copy: extract_xy short-circuits on a
         # fused-fit featurized block without materializing the chain
-        X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
-                             self.getOrDefault("labelCol"))
-        ok = np.isfinite(y)
-        X, y = X[ok], y[ok]
-        res = linear_impl.fit_linear(
-            X, y,
+        kw = dict(
             regParam=float(self.getOrDefault("regParam")),
             elasticNetParam=float(self.getOrDefault("elasticNetParam")),
             fitIntercept=bool(self.getOrDefault("fitIntercept")),
             standardization=bool(self.getOrDefault("standardization")),
             maxIter=int(self.getOrDefault("maxIter")),
             tol=float(self.getOrDefault("tol")))
+        compact = extract_compact(df, self.getOrDefault("featuresCol"),
+                                  self.getOrDefault("labelCol"))
+        if compact is not None:
+            # beyond-one-machine block: one-hot slots expand ON CHIP; the
+            # (n, d) matrix never exists host-side (featurizer.CompactParts)
+            parts, y = compact
+            res = linear_impl.fit_linear_compact(parts, y, **kw)
+            X = parts
+        else:
+            X, y, _ = extract_xy(df, self.getOrDefault("featuresCol"),
+                                 self.getOrDefault("labelCol"))
+            ok = np.isfinite(y)
+            X, y = X[ok], y[ok]
+            res = linear_impl.fit_linear(X, y, **kw)
         model = LinearRegressionModel(coefficients=res.coefficients,
                                       intercept=res.intercept)
         model._inherit_params(self)
@@ -108,7 +117,10 @@ class LinearRegression(Estimator, _PredictorParams):
         var_y = st.get("var_y", 0.0)
 
         def lazy_mae(X=X, y=y, w=res.coefficients, b=res.intercept):
-            pred = linear_impl.predict_linear(X, w, b)
+            if compact is not None:
+                pred = X.predict_affine(w, b)
+            else:
+                pred = linear_impl.predict_linear(X, w, b)
             return float(np.mean(np.abs(y - pred)))
 
         model._summary = LinearRegressionSummary(
