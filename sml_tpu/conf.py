@@ -57,6 +57,10 @@ _register("sml.applyInPandas.parallelism", 8, int,
 _register("sml.predict.binCacheBytes", 1 << 30, int,
           "LRU byte bound for memoized predict-time binned matrices (CV/"
           "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
+_register("sml.split.sampler", "spark", str,
+          "randomSplit sampler: 'spark' = draw-for-draw Spark parity "
+          "(per-partition determinism sort + XORShiftRandom Bernoulli "
+          "cells); 'legacy' = the pre-r5 numpy draws")
 _register("sml.shuffle.reuseBytes", 1 << 30, int,
           "Byte bound for the shuffle-reuse cache (memoized applyInPandas "
           "group splits of cached frames); 0 disables reuse")

@@ -125,6 +125,10 @@ class DataFrame:
         # equivalent of Spark ML's column metadata that tree learners read
         # for maxBins semantics (`ML 06:91-126`).
         self._ml_attrs: Dict[str, Any] = {}
+        # (weights, seed) -> child frames: repeated identical randomSplits
+        # return the same (immutable, deterministic) children so downstream
+        # caches stay hot — see randomSplit
+        self._split_memo: Dict[tuple, list] = {}
 
     # ------------------------------------------------------------------ core
     @classmethod
@@ -579,22 +583,48 @@ class DataFrame:
 
     # -------------------------------------------------------------- sampling
     def randomSplit(self, weights: Sequence[float], seed: Optional[int] = None) -> List["DataFrame"]:
-        """Seeded per-partition split. Contract (documented, course-parity in
-        *behavior class*): each partition draws uniforms from
-        ``default_rng((seed << 16) + partition_index)`` so the result depends
-        on the partition layout exactly as demonstrated in `ML 02:38-52`."""
-        seed = int(seed) if seed is not None else np.random.SeedSequence().entropy % (2 ** 31)
+        """Spark's split, draw for draw (`frame/sampling.py`): each
+        partition is locally sorted (Dataset.randomSplit's determinism
+        sort), then every weight cell keeps row i iff its
+        `XORShiftRandom(seed + partitionIndex)` uniform lands in the
+        cell's [lo, hi) — so the result depends on the partition layout
+        exactly as the course demonstrates (`ML 02:38-52`), with Spark's
+        published sampler semantics (BernoulliCellSampler over the
+        hashSeed-scrambled XORShift stream). Set
+        ``sml.split.sampler=legacy`` for the pre-r5 numpy draws.
+
+        Identical (weights, seed) splits of this frame return the SAME
+        child frames (plan-cache reuse: frames are immutable and the
+        sampler is deterministic, so the children are observationally
+        identical — but repeated ML 02-style split→fit flows keep their
+        downstream staging/shuffle caches hot)."""
+        explicit_seed = seed is not None
+        seed = int(seed) if explicit_seed else int(np.random.SeedSequence().entropy % (2 ** 31))
+        sampler_mode = str(GLOBAL_CONF.get("sml.split.sampler"))
+        memo_key = (tuple(float(w) for w in weights), seed, sampler_mode)
+        if explicit_seed:
+            hit = self._split_memo.get(memo_key)
+            if hit is not None:
+                return list(hit)
         total = float(sum(weights))
         bounds = np.cumsum([w / total for w in weights])
         parent = self
+        legacy = sampler_mode == "legacy"
 
         def make(i: int) -> DataFrame:
             lo = 0.0 if i == 0 else bounds[i - 1]
             hi = bounds[i]
 
             def fn(pdf: pd.DataFrame, ctx: EvalContext) -> pd.DataFrame:
-                rng = np.random.default_rng((seed << 16) + ctx.partition_index)
-                u = rng.random(len(pdf))
+                if legacy:
+                    rng = np.random.default_rng(
+                        (seed << 16) + ctx.partition_index)
+                    u = rng.random(len(pdf))
+                    mask = (u >= lo) & (u < hi)
+                    return pdf[mask].reset_index(drop=True)
+                from .sampling import partition_uniforms, presplit_sort
+                pdf = presplit_sort(pdf)
+                u = partition_uniforms(seed, ctx.partition_index, len(pdf))
                 mask = (u >= lo) & (u < hi)
                 return pdf[mask].reset_index(drop=True)
 
@@ -602,7 +632,12 @@ class DataFrame:
             out._op = "randomSplit"
             return out
 
-        return [make(i) for i in range(len(weights))]
+        outs = [make(i) for i in range(len(weights))]
+        if explicit_seed:
+            if len(self._split_memo) >= 4:
+                self._split_memo.pop(next(iter(self._split_memo)))
+            self._split_memo[memo_key] = list(outs)
+        return outs
 
     def sample(self, withReplacement: bool = False, fraction: float = 0.1,
                seed: Optional[int] = None) -> "DataFrame":

@@ -40,7 +40,10 @@ _split_lock = _threading.Lock()
 
 
 def _split_cache_put(ckey, token, groups) -> None:
-    nbytes = int(sum(int(g.memory_usage(deep=False).sum()) for g in groups))
+    # deep accounting: the split's cost IS its string payloads (shallow
+    # counts object columns at pointer size), plus the pinned token frame
+    nbytes = int(sum(int(g.memory_usage(deep=True).sum()) for g in groups))
+    nbytes += int(token.memory_usage(deep=True).sum())
     max_bytes = GLOBAL_CONF.getInt("sml.shuffle.reuseBytes")
     if nbytes > max_bytes:
         return

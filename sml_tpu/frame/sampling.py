@@ -1,0 +1,191 @@
+"""Spark's randomSplit sampler, draw for draw (SURVEY §4 north star;
+VERDICT r4 missing #1).
+
+The course makes split mechanics a first-class lesson: `randomSplit(seed=42)`
+results change with the partition layout (`SML/Scalable-Machine-Learning-
+with-Apache-Spark/ML 02 - Linear Regression I.py:38-52`). Spark's mechanism
+is a published pure algorithm, reimplemented here without a JVM:
+
+- `Dataset.randomSplit` first SORTS each partition locally by every
+  sortable column ascending (to make per-partition row order
+  deterministic), then samples each weight cell
+  (sql/core/.../Dataset.scala `randomSplit`).
+- Each cell is a `BernoulliCellSampler(lb, ub)`: one uniform draw per row,
+  row kept iff `lb <= x < ub` — no gap sampling
+  (core/.../util/random/RandomSampler.scala).
+- The per-partition RNG is `XORShiftRandom` seeded with
+  `seed + partitionIndex`, whose init scrambles the seed through
+  MurmurHash3 of its 8 big-endian bytes
+  (core/.../util/random/XORShiftRandom.scala `hashSeed`), and whose
+  `nextDouble` is java.util.Random's two-word construction over the
+  XORShift `next(bits)`.
+
+Known deviation (documented): our frames store SQL NULL as NaN, so the
+pre-split sort places missing doubles FIRST (pandas na_position) where
+Spark places true NaN LAST and NULL first — frames with missing numeric
+values can order ties differently. String sort is bytewise-equal to
+Spark's UTF8 binary order for ASCII data.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+# ---------------------------------------------------------------- MurmurHash3
+# scala.util.hashing.MurmurHash3.bytesHash over the 8 big-endian bytes of
+# the seed — exactly XORShiftRandom.hashSeed. Words are read little-endian
+# (scala bytesHash); 8 bytes = 2 full words, no tail.
+_ARRAY_SEED = 0x3C074A61  # scala.util.hashing.MurmurHash3.arraySeed
+
+_M = 0xFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _mm3_bytes8(data: bytes, seed: int) -> int:
+    """murmur3_x86_32 over exactly 8 bytes (scala bytesHash semantics)."""
+    h = seed & _M
+    for i in (0, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * 0xCC9E2D51) & _M
+        k = _rotl(k, 15)
+        k = (k * 0x1B873593) & _M
+        h ^= k
+        h = _rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M
+    h ^= 8  # finalize with length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M
+    h ^= h >> 16
+    return h
+
+
+def hash_seed(seed: int) -> int:
+    """XORShiftRandom.hashSeed: two chained MurmurHash3 passes over the
+    seed's 8 big-endian bytes -> 64-bit init state."""
+    data = (seed & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
+    low = _mm3_bytes8(data, _ARRAY_SEED)
+    high = _mm3_bytes8(data, low)
+    return ((high << 32) | low) & 0xFFFFFFFFFFFFFFFF
+
+
+# ------------------------------------------------------------ XORShiftRandom
+class XORShiftRandom:
+    """Pure-python reference (the native kernel is the fast path)."""
+
+    def __init__(self, seed: int):
+        self._s = hash_seed(seed)
+
+    def _next(self, bits: int) -> int:
+        s = self._s
+        x = (s ^ (s << 21)) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 35
+        x = (x ^ (x << 4)) & 0xFFFFFFFFFFFFFFFF
+        self._s = x
+        return x & ((1 << bits) - 1)
+
+    def next_double(self) -> float:
+        return ((self._next(26) << 27) + self._next(27)) * (2.0 ** -53)
+
+
+_lib_lock = threading.Lock()
+_lib_state: dict = {}
+
+
+def _xorshift_lib():
+    with _lib_lock:
+        if "lib" not in _lib_state:
+            from ..native.build import load_library
+            lib = load_library("xorshift")
+            if lib is not None:
+                lib.xorshift_fill_doubles.argtypes = [
+                    ctypes.c_longlong, ctypes.c_longlong,
+                    ctypes.POINTER(ctypes.c_double)]
+                lib.xorshift_fill_doubles.restype = None
+            _lib_state["lib"] = lib
+        return _lib_state["lib"]
+
+
+def partition_uniforms(seed: int, partition_index: int, n: int) -> np.ndarray:
+    """The n sequential nextDouble draws Spark's sampler makes for one
+    partition: XORShiftRandom(seed + partitionIndex). Every weight cell of
+    one randomSplit re-draws this same sequence (Spark seeds each cell's
+    sampler identically), which is what makes the splits disjoint and
+    exhaustive."""
+    out = np.empty(n, dtype=np.float64)
+    if n == 0:
+        return out
+    hashed = hash_seed(seed + partition_index)
+    lib = _xorshift_lib()
+    if lib is not None:
+        lib.xorshift_fill_doubles(
+            ctypes.c_longlong(
+                hashed - (1 << 64) if hashed >= (1 << 63) else hashed),
+            ctypes.c_longlong(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out
+    rng = XORShiftRandom(seed + partition_index)
+    rng._s = hashed  # skip re-hashing
+    for i in range(n):
+        out[i] = rng.next_double()
+    return out
+
+
+# ------------------------------------------------------- pre-split local sort
+_sort_memo: dict = {}
+_sort_lock = threading.Lock()
+
+
+def presplit_sort(pdf: pd.DataFrame) -> pd.DataFrame:
+    """Dataset.randomSplit's per-partition local sort: every sortable
+    column ascending, in schema order, nulls first — making row order
+    deterministic regardless of upstream partition materialization.
+    Unsortable columns (vector/extension payloads, mixed objects) are
+    pruned from the sort order, as Spark prunes unsortable types."""
+    hit = _sort_memo.get(id(pdf))
+    if hit is not None and hit[0] is pdf:
+        return hit[1]
+    cols = []
+    for c in pdf.columns:
+        dt = pdf[c].dtype
+        if dt.kind in "ifubMm" or isinstance(dt, pd.StringDtype):
+            cols.append(c)
+        elif dt == object or "string" in str(dt) or "large_string" in str(dt):
+            cols.append(c)
+    out = pdf
+    if cols:
+        try:
+            out = pdf.sort_values(cols, kind="stable", na_position="first",
+                                  ignore_index=True)
+        except Exception:
+            # a column that passed the dtype screen but still won't sort
+            # (mixed-type object payloads): drop offenders one at a time —
+            # probing a head slice can miss a late mixed value
+            sortable = list(cols)
+            while sortable:
+                try:
+                    out = pdf.sort_values(sortable, kind="stable",
+                                          na_position="first",
+                                          ignore_index=True)
+                    break
+                except Exception:
+                    sortable.pop()
+            else:
+                out = pdf
+    # memoize per partition object: every weight cell of one randomSplit
+    # sorts the SAME partition — k cells must not pay k sorts. Strong ref
+    # to the source keeps its id valid; small FIFO bound.
+    with _sort_lock:
+        _sort_memo[id(pdf)] = (pdf, out)
+        while len(_sort_memo) > 32:
+            _sort_memo.pop(next(iter(_sort_memo)))
+    return out

@@ -388,10 +388,13 @@ class CompiledFeaturizer:
                     c = _numeric(pdf[s.inner.col])
                     if s.inner.fill is not None:
                         c = np.where(np.isfinite(c), c, s.inner.fill)
-                if not np.isfinite(c).all():
-                    return None  # NaN one-hot row: generic-path semantics
+                # rows the indexer marked for dropping may carry NaN codes
+                # (they never reach the expanded block); any OTHER NaN
+                # means a NaN one-hot row — generic-path semantics, bail
+                if not np.isfinite(np.where(drop, 0.0, c)).all():
+                    return None
                 layout.append(("oh", len(code_cols), s.width))
-                code_cols.append(c.astype(np.int32))
+                code_cols.append(np.where(drop, 0.0, c).astype(np.int32))
             else:
                 return None
         if num_srcs:
@@ -399,7 +402,7 @@ class CompiledFeaturizer:
                                 for s in num_srcs])
             num = extract_numeric_block(
                 pdf, [s.col for s in num_srcs], fills).astype(np.float32)
-            if not np.isfinite(num).all():
+            if not np.isfinite(num[~drop]).all():
                 return None  # NaN feature: generic path raises/poisons
         else:
             num = np.zeros((n, 0), dtype=np.float32)

@@ -127,10 +127,13 @@ def test_als_matches_numpy_reference_across_shards(spark):
     uf = np.asarray(model._uf)
     itf = np.asarray(model._if)
 
-    # independent dense f64 reference with the SAME init draws
+    # independent dense f64 reference with the SAME init draws (the
+    # MLlib-style |N(0,1)| unit-norm rows the fit uses)
     init = np.random.default_rng(9)
-    uf_ref = (init.standard_normal((U, r)) * 0.1).astype(np.float64)
-    if_ref = (init.standard_normal((I, r)) * 0.1).astype(np.float64)
+    uf_ref = np.abs(init.standard_normal((U, r))).astype(np.float64)
+    if_ref = np.abs(init.standard_normal((I, r))).astype(np.float64)
+    uf_ref /= np.linalg.norm(uf_ref, axis=1, keepdims=True) + 1e-12
+    if_ref /= np.linalg.norm(if_ref, axis=1, keepdims=True) + 1e-12
     u = pdf["user"].to_numpy()
     i = pdf["item"].to_numpy()
     rat = pdf["rating"].to_numpy(np.float64)
