@@ -338,7 +338,21 @@ class DataFrame:
             out[name] = cc._eval(pdf, ctx).reset_index(drop=True).values
             return out
 
-        return self._derive(fn)
+        out = self._derive(fn)
+        # evaluator-pushdown propagation: replacing the prediction column
+        # with a known elementwise link of ITSELF (the ML 11 shape —
+        # train on log(price), exponentiate predictions, evaluate on the
+        # original scale) keeps the fused-eval hook alive with the link
+        # composed into its device program
+        hook = getattr(self, "_fused_eval", None)
+        unary = getattr(cc, "_unary_of", None)
+        if hook is not None and unary is not None and unary[1] == name:
+            # with_link verifies `name` is the hook's OWN prediction column
+            # (a link over any other column must kill the hook, not wrap it)
+            linked = hook.with_link(unary[0], name)
+            if linked is not None:
+                out._fused_eval = linked
+        return out
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         return self._derive(lambda pdf, ctx: pdf.rename(columns={old: new}))

@@ -36,8 +36,14 @@ def lit(value: Any) -> Column:
 def _unary(name: str, fn):
     def wrapper(c: ColumnOrName) -> Column:
         cc = ensure_column(c)
-        return Column(lambda pdf, ctx: fn(pd.to_numeric(cc._eval(pdf, ctx), errors="coerce")),
-                      f"{name}({cc._name})")
+        out = Column(lambda pdf, ctx: fn(pd.to_numeric(cc._eval(pdf, ctx), errors="coerce")),
+                     f"{name}({cc._name})")
+        from .column import NamedColumn
+        if isinstance(cc, NamedColumn):
+            # pattern tag for withColumn's evaluator-pushdown propagation:
+            # "this expression is <name> applied to the raw column <col>"
+            out._unary_of = (name, cc._name)
+        return out
     wrapper.__name__ = name
     return wrapper
 

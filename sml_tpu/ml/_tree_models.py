@@ -349,7 +349,8 @@ class _TreeModelBase(Model, _TreeParams):
         self._spec = _EnsembleSpec.load(path)
 
 
-def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray):
+def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray,
+                                link: str = "identity"):
     """The fused traverse+metric device pass over a raw feature matrix:
     bins (content-memoized), routes, and — on the device route — returns
     the five regression sufficient statistics from ONE program dispatch
@@ -358,6 +359,10 @@ def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray):
     tree-model hook and the fused-pipeline hook."""
     if spec.mode != "regression":
         return None
+    if link != "identity":
+        import jax.numpy as _jnp
+        if getattr(_jnp, link, None) is None:
+            return None  # unresolvable device link: materialize path wins
     from ..utils.profiler import PROFILER
     with PROFILER.span("binning.predict", rows=int(X.shape[0])):
         binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
@@ -378,7 +383,7 @@ def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray):
         from .inference import forest_eval_fn
         sf, sb, lv, w = spec.stacked()
         stats = run_data_parallel(
-            forest_eval_fn(spec.depth), binned32, l32, f32,
+            forest_eval_fn(spec.depth, link), binned32, l32, f32,
             replicated=(np.asarray(sf), np.asarray(sb),
                         np.asarray(lv, dtype=np.float32),
                         np.asarray(w, dtype=np.float32),
@@ -397,7 +402,8 @@ class _TreeEvalHook(RegStatsHook):
     def _compute(self, raw, lab, label_col: str):
         model = self._tail
         X = extract_features(raw, model.getOrDefault("featuresCol"))
-        return fused_reg_stats_from_matrix(model._spec, X, lab)
+        return fused_reg_stats_from_matrix(model._spec, X, lab,
+                                           link=self._link)
 
 
 class _TreeRegressionModel(_TreeModelBase):

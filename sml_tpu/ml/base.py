@@ -325,10 +325,36 @@ class RegStatsHook:
     means: the evaluator takes the ordinary materialize path, so results
     never depend on the hook firing."""
 
+    # names only — resolved via getattr(np, name) host-side and
+    # getattr(jnp, name) in the device program, so the two sides cannot
+    # drift (numpy and jax.numpy mirror these fn names)
+    LINKS = frozenset({"identity", "exp", "log"})
+
     def __init__(self, tail, parent):
         self._tail = tail
         self._parent = parent
         self._stats_cache: dict = {}
+        self._link = "identity"
+
+    def with_link(self, link: str, col_name: str):
+        """A clone of this hook whose predictions pass through the
+        elementwise `link` before the metric reductions — the ML 11 shape
+        (fit on log(label), evaluate exp(prediction) on the raw scale).
+        Returns None (caller keeps NO hook) unless `col_name` is this
+        hook's own prediction column, the link is known, and no link is
+        already applied."""
+        if link not in self.LINKS or self._link != "identity":
+            return None
+        try:
+            if self._tail.getOrDefault("predictionCol") != col_name:
+                return None
+        except Exception:
+            return None
+        import copy
+        clone = copy.copy(self)
+        clone._link = link
+        clone._stats_cache = {}
+        return clone
 
     def _label_ok(self, label_col: str) -> bool:
         return True
@@ -385,14 +411,18 @@ class _ScorerEvalHook(RegStatsHook):
         spec = getattr(self._tail, "_spec", None)
         if spec is not None and hasattr(spec, "trees"):
             # tree tail: the whole traverse+metric fuses into one device
-            # program (five-scalar D2H) when the router agrees
+            # program (five-scalar D2H) when the router agrees; the link
+            # (if any) is applied to predictions INSIDE the program
             from ._tree_models import fused_reg_stats_from_matrix
-            stats = fused_reg_stats_from_matrix(spec, X, lab)
+            stats = fused_reg_stats_from_matrix(spec, X, lab,
+                                                link=self._link)
             if stats is not None:
                 return stats
         pred = np.asarray(self._scorer.score_block(X), dtype=np.float64)
         if pred.shape[0] != lab.shape[0]:
             return None
+        if self._link != "identity":
+            pred = getattr(np, self._link)(pred)
         from .evaluation import host_reg_stats
         return host_reg_stats(pred, lab)
 

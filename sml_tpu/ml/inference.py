@@ -110,7 +110,7 @@ def _forest_program(depth: int):
 _forest_eval_fns: dict = {}
 
 
-def forest_eval_fn(depth: int):
+def forest_eval_fn(depth: int, link: str = "identity"):
     """Fused predict+metric program for the evaluator pushdown: traverse
     the stacked ensemble AND reduce the five regression sufficient
     statistics in one dispatch — D2H is five scalars instead of a
@@ -119,14 +119,32 @@ def forest_eval_fn(depth: int):
     `_pred_label`'s finite filter); labels are pre-zeroed at masked rows so
     padding and NaN labels are inert under psum.
 
-    Module-level per-depth fn identity so cached_data_parallel's program
-    cache hits across calls."""
-    fn = _forest_eval_fns.get(depth)
+    `link` applies a known elementwise fn to predictions INSIDE the
+    program (the ML 11 shape: fit on log(label), metric on
+    exp(prediction) — `SML/ML 11 - XGBoost.py`'s log-price flow).
+
+    Module-level per-(depth, link) fn identity so cached_data_parallel's
+    program cache hits across calls."""
+    key = (depth, link)
+    fn = _forest_eval_fns.get(key)
     if fn is not None:
         return fn
+    # resolved from the ONE registry (base.RegStatsHook.LINKS holds the
+    # names; np/jnp mirror them) — callers guard resolvability first
+    link_fn = None if link == "identity" else getattr(jnp, link)
 
     def forest_eval(binned_b, l, lmask, mask, sf, sb, lv, weights, base):
         pred = base + _forest_margin(binned_b, sf, sb, lv, weights, depth)
+        if link_fn is not None:
+            pred = link_fn(pred)
+            # the link can produce NaN/inf (log of a <=0 margin, exp
+            # overflow — including at PADDING rows, whose garbage margins
+            # are otherwise inert): fold finiteness into the mask and
+            # zero dead predictions so NaN*0 never reaches the psums.
+            # Matches the host paths, which filter non-finite predictions
+            ok = jnp.isfinite(pred)
+            mask = mask * ok.astype(jnp.float32)
+            pred = jnp.where(ok, pred, 0.0)
         m = mask * lmask
         d = (pred - l) * m
         from ..parallel import collectives as _coll
@@ -137,8 +155,9 @@ def forest_eval_fn(depth: int):
         sl2 = _coll.psum(jnp.sum(m * l * l))
         return n, se, ae, sl, sl2
 
-    forest_eval.__name__ = f"forest_eval_d{depth}"
-    _forest_eval_fns[depth] = forest_eval
+    forest_eval.__name__ = f"forest_eval_d{depth}" + \
+        ("" if link == "identity" else f"_{link}")
+    _forest_eval_fns[key] = forest_eval
     return forest_eval
 
 

@@ -118,3 +118,50 @@ def test_pushdown_ignored_for_mismatched_prediction_col(spark):
     ev = RegressionEvaluator(labelCol="label", predictionCol="my_pred")
     assert np.isfinite(ev.evaluate(lazy))
     assert lazy._fused_eval.reg_stats("prediction", "label") is None
+
+
+def test_link_pushdown_matches_materialized(spark):
+    """ML 11's shape: fit on log(label), evaluate exp(prediction) on the
+    raw scale. The withColumn(exp(pred)) frame keeps a LINKED fused-eval
+    hook whose device program applies exp inside; the metric must equal
+    the materialized path exactly."""
+    import numpy as np
+    import pandas as pd
+    from sml_tpu.frame import functions as F
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.evaluation import RegressionEvaluator
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import GBTRegressor
+
+    rng = np.random.default_rng(5)
+    n = 6000
+    pdf = pd.DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+    pdf["price"] = np.exp(0.5 * pdf.x1 - 0.2 * pdf.x2
+                          + rng.normal(0, 0.1, n) + 3.0)
+    df = spark.createDataFrame(pdf)
+    train, test = df.randomSplit([0.8, 0.2], seed=42)
+    log_train = train.withColumn("label", F.log(F.col("price")))
+    log_test = test.withColumn("label", F.log(F.col("price")))
+    va = VectorAssembler(inputCols=["x1", "x2"], outputCol="features")
+    m = Pipeline(stages=[va, GBTRegressor(labelCol="label", maxDepth=3,
+                                          maxIter=8)]).fit(log_train)
+    pred = m.transform(log_test).withColumn(
+        "prediction", F.exp(F.col("prediction")))
+    # linked hook is attached and tagged
+    hook = getattr(pred, "_fused_eval", None)
+    assert hook is not None and hook._link == "exp"
+    ev = RegressionEvaluator(labelCol="price", metricName="rmse")
+    rmse_hook = ev.evaluate(pred)
+    # materialized ground truth
+    pp = m.transform(log_test).toPandas()
+    truth = float(np.sqrt(np.mean(
+        (np.exp(pp["prediction"]) - pp["price"]) ** 2)))
+    assert abs(rmse_hook - truth) < 1e-6 * max(truth, 1.0)
+
+    # a link over a NON-prediction column must drop the hook, and a
+    # second link over an already-linked hook must too
+    other = m.transform(log_test).withColumn("price",
+                                             F.exp(F.col("price")))
+    assert getattr(other, "_fused_eval", None) is None
+    double = pred.withColumn("prediction", F.exp(F.col("prediction")))
+    assert getattr(double, "_fused_eval", None) is None

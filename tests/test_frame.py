@@ -259,3 +259,49 @@ def test_tail(spark):
     rows = df.tail(3)
     assert [r["x"] for r in rows] == [7, 8, 9]
     assert len(df.tail(99)) == 10
+
+
+def test_shuffle_reuse_cache_and_unpersist(spark):
+    """applyInPandas memoizes the group split of a cached frame; a
+    mutating fn cannot pollute it; unpersist drops the entries; the byte
+    bound refuses oversized splits."""
+    import pandas as pd
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.frame import grouped as G
+
+    pdf = pd.DataFrame({"k": ["a", "b", "c"] * 400,
+                        "v": np.arange(1200, dtype=float)})
+    df = spark.createDataFrame(pdf)
+    df.cache()
+    df.toPandas()
+
+    def fn(g):
+        g["v"] = -1.0  # hostile in-place mutation
+        return pd.DataFrame({"k": [g["k"].iloc[0]], "n": [len(g)]})
+
+    sch = "k string, n bigint"
+    r1 = df.groupby("k").applyInPandas(fn, sch).toPandas()
+    with G._split_lock:
+        assert any(v[0] is df.__dict__["_pdf_cache"]
+                   for v in G._split_cache.values())
+    r2 = df.groupby("k").applyInPandas(fn, sch).toPandas()
+    assert sorted(r1["n"]) == sorted(r2["n"]) == [400, 400, 400]
+    assert float(df.toPandas()["v"].min()) >= 0  # source unpolluted
+    token = df.__dict__["_pdf_cache"]
+    df.unpersist()
+    with G._split_lock:
+        assert not any(v[0] is token for v in G._split_cache.values())
+
+    # byte bound: a 0 budget refuses to cache at all
+    old = GLOBAL_CONF.get("sml.shuffle.reuseBytes")
+    GLOBAL_CONF.set("sml.shuffle.reuseBytes", 0)
+    try:
+        df2 = spark.createDataFrame(pdf)
+        df2.cache()
+        df2.toPandas()
+        df2.groupby("k").applyInPandas(fn, sch).toPandas()
+        tok2 = df2.__dict__["_pdf_cache"]
+        with G._split_lock:
+            assert not any(v[0] is tok2 for v in G._split_cache.values())
+    finally:
+        GLOBAL_CONF.set("sml.shuffle.reuseBytes", old)
