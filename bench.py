@@ -855,6 +855,13 @@ def main():
         "host_remeasured_this_run": sorted(fresh.keys()),
         "compile_seconds": round(compile_secs, 1),
         "warmup_seconds": round(warmup_secs, 1),
+        "warmup_note": "NOT XLA recompilation: with the persistent cache "
+                       "warm, jax logs show every program loading as a "
+                       "cache hit (0.1-0.8s each); the cost is the "
+                       "per-program FIRST-DISPATCH overhead on the "
+                       "tunneled backend (executable ship + device load "
+                       "+ python trace + route calibration) times ~25 "
+                       "distinct programs, paid once per process",
         "timed_pass_walls": pass_walls,
         "probe_calibration": cal_probe,
         "probes_per_pass": probes,
