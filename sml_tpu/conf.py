@@ -57,6 +57,12 @@ _register("sml.applyInPandas.parallelism", 8, int,
 _register("sml.predict.binCacheBytes", 1 << 30, int,
           "LRU byte bound for memoized predict-time binned matrices (CV/"
           "tuning suites hold ~20 (matrix, model-edges) pairs at once)")
+_register("sml.tree.histSubtraction", True, _to_bool,
+          "Histogram-subtraction tree builds (right child = parent - "
+          "left sibling): halves the hist matmul below the root. Exact "
+          "counts with the built-in integer sampling weights; fractional "
+          "fit_tree weights and grad/hess sums pick up depth-compounding "
+          "cancellation noise")
 _register("sml.split.sampler", "spark", str,
           "randomSplit sampler: 'spark' = draw-for-draw Spark parity "
           "(per-partition determinism sort + XORShiftRandom Bernoulli "

@@ -198,10 +198,31 @@ def _hist_dtype():
     return jnp.bfloat16 if plat == "tpu" else jnp.float32
 
 
-def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32):
+def _hist_subtract() -> bool:
+    from ..conf import GLOBAL_CONF
+    return GLOBAL_CONF.getBool("sml.tree.histSubtraction")
+
+
+def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
+                       subtract: bool = True):
     """Pure per-chip tree-build fn (called inside shard_map): one level-wise
     pass, histograms as one-hot dots, psum merges. Returns stacked node
-    arrays as a single (5, n_nodes) f32 pack (one transfer, one scan slot)."""
+    arrays as a single (5, n_nodes) f32 pack (one transfer, one scan slot).
+
+    `subtract` enables the classic HISTOGRAM-SUBTRACTION trick (LightGBM's
+    parent-minus-sibling): below the root, only LEFT children histogram
+    from rows; right children are parent − left, computed post-psum — the
+    one-hot hist matmul (the build's dominant FLOPs and HBM traffic)
+    halves at every level, and the psum payload halves with it. With the
+    built-in estimators' INTEGER sampling weights (Poisson/Bernoulli
+    draws, f32-exact ≤ 2^24) the count channel is exact, so the
+    min_instances gates cannot drift; grad/hess sums — and, for callers
+    passing arbitrary FRACTIONAL weights through fit_tree, the count
+    channel too — pick up cancellation noise that compounds with depth
+    (each parent was itself subtraction-derived), so a weight sum sitting
+    exactly on the min_instances boundary can gate differently than the
+    direct build. Nodes whose parent did NOT split are gated to zero,
+    exactly matching the direct computation (no rows ever reach them)."""
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
     n_nodes = 2 ** (D + 1) - 1
 
@@ -220,6 +241,8 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32):
         node_H = jnp.zeros((n_nodes,), dtype=jnp.float32)
         node_W = jnp.zeros((n_nodes,), dtype=jnp.float32)
 
+        hist_prev = None   # (F, B, width/2, 3) — previous level, post-psum
+        split_prev = None  # (width/2,) — previous level's do_split
         for level in range(D):
             width = 2 ** level
             base = width - 1
@@ -227,18 +250,45 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32):
             in_level = active & (lid >= 0) & (lid < width)
             lid_c = jnp.where(in_level, lid, 0)
             wq = jnp.where(in_level, weight, 0.0)
-            stats = jnp.stack([grad * wq, hess * wq, wq], axis=1)    # (n, 3)
-            node1hot = jax.nn.one_hot(lid_c, width, dtype=hist_dtype) \
-                * (wq > 0)[:, None].astype(hist_dtype)
-            ns = (node1hot[:, :, None] * stats[:, None, :].astype(hist_dtype)
-                  ).reshape(n, width * 3)
+            if subtract and level > 0:
+                # rows histogram only into their LEFT-child slot; right
+                # children come from parent − left below
+                half = width // 2
+                is_left = (lid_c % 2) == 0
+                wl = jnp.where(is_left, wq, 0.0)
+                node1hot = jax.nn.one_hot(lid_c // 2, half,
+                                          dtype=hist_dtype) \
+                    * (wl > 0)[:, None].astype(hist_dtype)
+                stats_l = jnp.stack([grad * wl, hess * wl, wl], axis=1)
+                ns = (node1hot[:, :, None]
+                      * stats_l[:, None, :].astype(hist_dtype)
+                      ).reshape(n, half * 3)
+            else:
+                stats = jnp.stack([grad * wq, hess * wq, wq], axis=1)
+                node1hot = jax.nn.one_hot(lid_c, width, dtype=hist_dtype) \
+                    * (wq > 0)[:, None].astype(hist_dtype)
+                ns = (node1hot[:, :, None]
+                      * stats[:, None, :].astype(hist_dtype)
+                      ).reshape(n, width * 3)
             # bf16 operands (the one-hot side is EXACT in bf16), f32
             # accumulation: the MXU's native mode. B1t is pre-transposed
             # OUTSIDE the tree scan — a .T here would re-materialize a
             # ~1GB transpose every level of every tree
             hist = coll.psum(jax.lax.dot_general(
                 B1t, ns, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)).reshape(F, B, width, 3)
+                preferred_element_type=jnp.float32))
+            if subtract and level > 0:
+                half = width // 2
+                left = hist.reshape(F, B, half, 3)
+                # a parent that did not split has no children: gate its
+                # whole histogram to zero, as the direct path computes
+                parent = hist_prev * \
+                    split_prev.astype(jnp.float32)[None, None, :, None]
+                right = parent - left
+                hist = jnp.stack([left, right], axis=3) \
+                    .reshape(F, B, width, 3)
+            else:
+                hist = hist.reshape(F, B, width, 3)
             hG = jnp.transpose(hist[..., 0], (2, 0, 1))              # (width,F,B)
             hH = jnp.transpose(hist[..., 1], (2, 0, 1))
             hW = jnp.transpose(hist[..., 2], (2, 0, 1))
@@ -292,6 +342,8 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32):
             child = 2 * node + 1 + go_right.astype(jnp.int32)
             node = jnp.where(in_level & my_split, child, node)
             active = in_level & my_split
+            hist_prev = hist
+            split_prev = do_split
 
         # leaf stats for the last level
         width = 2 ** D
@@ -362,7 +414,7 @@ def _make_ensemble_program(es: EnsembleSpec):
     per-tree host round-trips (expensive over a TPU tunnel) disappear."""
     spec = es.tree
     hist_dtype = _hist_dtype()
-    build = _make_tree_builder(spec, hist_dtype)
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
 
     def program(binned, y, mask, rng):
@@ -431,7 +483,7 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
 def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                             seed: int = 0):
     from ..parallel import mesh as _meshlib
-    key = (es, id(_meshlib.get_mesh()))  # programs are mesh-specific
+    key = (es, id(_meshlib.get_mesh()), _hist_subtract())
     if key not in _ensemble_cache:
         _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
                                              replicated_argnums=(3,))
@@ -527,7 +579,7 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
     y_dev = stage_stacked_cached(yst)
     m_dev = stage_stacked_cached(mst)
 
-    key = (es, fo, id(mesh))
+    key = (es, fo, id(mesh), _hist_subtract())
     if key not in _folds_cache:
         program = _make_ensemble_program(es)
 
@@ -560,7 +612,7 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
 def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32):
     """Single-tree program (kept for the dryrun/compile-check path)."""
     B, F = spec.n_bins, spec.n_features
-    build = _make_tree_builder(spec, hist_dtype)
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
 
     def program(binned, grad, hess, weight, feat_rng):
         n = binned.shape[0]
@@ -579,7 +631,7 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
              rng: int = 0, feat_key: Optional[np.ndarray] = None) -> FittedTree:
     """Build one tree on the mesh from pre-staged device arrays."""
     from ..parallel import mesh as _meshlib
-    key = (spec, id(_meshlib.get_mesh()))  # programs are mesh-specific
+    key = (spec, id(_meshlib.get_mesh()), _hist_subtract())
     if key not in _tree_cache:
         _tree_cache[key] = data_parallel(
             _build_tree_program(spec, _hist_dtype()), replicated_argnums=(4,))

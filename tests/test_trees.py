@@ -185,3 +185,45 @@ def test_native_binning_matches_numpy():
         np.testing.assert_array_equal(out[:, :5], ref[:, :5])
     # predict-time binning round-trips
     np.testing.assert_array_equal(bin_with(X, binning), binned)
+
+
+def test_hist_subtraction_matches_direct(spark):
+    """The histogram-subtraction build (right child = parent - left) must
+    reproduce the direct build: identical split structure, leaf values
+    within f32 cancellation noise."""
+    import numpy as np
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import GBTRegressor, RandomForestRegressor
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    import pandas as pd
+    pdf = pd.DataFrame({f"f{i}": rng.normal(size=n) for i in range(6)})
+    pdf["label"] = (pdf.f0 * 2 - pdf.f1 + (pdf.f2 > 0) * 3
+                    + rng.normal(0, 0.3, n))
+    df = spark.createDataFrame(pdf)
+    va = VectorAssembler(inputCols=[f"f{i}" for i in range(6)],
+                         outputCol="features")
+    old = GLOBAL_CONF.get("sml.tree.histSubtraction")
+    try:
+        for est_fn in (
+            lambda: RandomForestRegressor(labelCol="label", maxDepth=5,
+                                          numTrees=6, maxBins=32, seed=7),
+            lambda: GBTRegressor(labelCol="label", maxDepth=4, maxIter=8,
+                                 maxBins=32),
+        ):
+            specs = {}
+            for flag in (False, True):
+                GLOBAL_CONF.set("sml.tree.histSubtraction", flag)
+                specs[flag] = Pipeline(stages=[va, est_fn()]) \
+                    .fit(df).stages[-1]._spec
+            for ta, tb in zip(specs[False].trees, specs[True].trees):
+                np.testing.assert_array_equal(ta.split_feature,
+                                              tb.split_feature)
+                np.testing.assert_array_equal(ta.split_bin, tb.split_bin)
+                np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                           atol=1e-3)
+    finally:
+        GLOBAL_CONF.set("sml.tree.histSubtraction", old)
