@@ -648,7 +648,10 @@ class DataFrame:
 
         outs = [make(i) for i in range(len(weights))]
         if explicit_seed:
-            if len(self._split_memo) >= 4:
+            # 2-deep: each entry's children, once materialized, pin ~one
+            # dataset copy each — a wider memo could hold several copies
+            # of a large cached frame for no realistic reuse pattern
+            if len(self._split_memo) >= 2:
                 self._split_memo.pop(next(iter(self._split_memo)))
             self._split_memo[memo_key] = list(outs)
         return outs

@@ -152,6 +152,7 @@ def test_link_pushdown_matches_materialized(spark):
     assert hook is not None and hook._link == "exp"
     ev = RegressionEvaluator(labelCol="price", metricName="rmse")
     rmse_hook = ev.evaluate(pred)
+    assert pred._parts is None  # the hook served; no materialization
     # materialized ground truth
     pp = m.transform(log_test).toPandas()
     truth = float(np.sqrt(np.mean(
@@ -165,3 +166,35 @@ def test_link_pushdown_matches_materialized(spark):
     assert getattr(other, "_fused_eval", None) is None
     double = pred.withColumn("prediction", F.exp(F.col("prediction")))
     assert getattr(double, "_fused_eval", None) is None
+
+
+def test_link_pushdown_on_bare_tree_transform(spark):
+    """The link propagation also covers the CV/tuning shape: a bare tree
+    model's transform over a featurized frame carries _TreeEvalHook, and
+    withColumn(exp(pred)) keeps it linked."""
+    from sml_tpu.frame import functions as F
+
+    rng = np.random.default_rng(9)
+    n = 5000
+    pdf = pd.DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+    pdf["label"] = 0.4 * pdf.x1 - 0.3 * pdf.x2 + rng.normal(0, 0.1, n) + 2.0
+    pdf["price"] = np.exp(pdf["label"])
+    df = spark.createDataFrame(pdf)
+    feat = Pipeline(stages=[VectorAssembler(
+        inputCols=["x1", "x2"], outputCol="features")]).fit(df).transform(df)
+    feat.cache()
+    m = RandomForestRegressor(labelCol="label", maxDepth=4, numTrees=6,
+                              seed=3).fit(feat)
+    pred = m.transform(feat).withColumn("prediction",
+                                       F.exp(F.col("prediction")))
+    hook = getattr(pred, "_fused_eval", None)
+    assert hook is not None and hook._link == "exp"
+    rmse = RegressionEvaluator(labelCol="price",
+                               metricName="rmse").evaluate(pred)
+    # the HOOK must have served the metric: the lazy frame stays
+    # unmaterialized (otherwise this only re-tests the fallback path)
+    assert pred._parts is None
+    pp = m.transform(feat).toPandas()
+    truth = float(np.sqrt(np.mean(
+        (np.exp(pp["prediction"]) - pp["price"]) ** 2)))
+    assert abs(rmse - truth) < 1e-6 * max(truth, 1.0)
