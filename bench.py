@@ -41,7 +41,9 @@ flagged (`interference_suspected`) instead of silently reported.
 of the same legs. Expensive legs (>30s host) come from the committed
 cache (baseline_host.json); every cheap leg is RE-MEASURED in this run
 on this machine (r4's losing legs were host-path times compared against
-a baseline captured on a different, uncontended machine).
+a baseline captured on a different, uncontended machine) with the SAME
+best-of-N discipline as the device legs (best of HOST_TIMED_PASSES),
+so vs_baseline compares best-against-best instead of best-against-one.
 
 Run `python bench.py --pin-goldens` on the virtual CPU mesh to (re)pin
 the 1M-row metric goldens that the TPU run is checked against.
@@ -75,6 +77,13 @@ GOLDEN_FILE = os.path.join(HERE, "GOLDEN.json")
 # slower legs (30s-minutes, won by 10-50x margins that dwarf machine
 # variance) come from the committed cache
 HOST_REMEASURE_CUTOFF_S = 30.0
+
+# re-measured host legs run this many passes and report their BEST — the
+# SAME best-of-N discipline the device legs get (ADVICE r5 medium: one
+# host pass against best-of-3 device passes structurally inflated
+# vs_baseline). Expensive cached legs stay single-pass (their 10-50x
+# margins dwarf pass noise; the sidecar labels them "cached").
+HOST_TIMED_PASSES = 3
 
 # peak dense f32 throughput used for the MFU estimate when running on a
 # real TPU chip (v5e-class); on CPU the estimate is skipped
@@ -641,6 +650,49 @@ def probe():
             "host_ms": round(min(host_ms), 2)}
 
 
+def second_fit_probe(train):
+    """Quantized-engine acceptance probe: two IDENTICAL-shape XGBoost fits
+    in this (so-far tree-cold) process. Fit 1 pays python trace, XLA
+    compile (or persistent-cache load), host binning, and H2D staging;
+    fit 2 must ride the compiled-program cache, the quantized bin-index
+    cache, and the staged device buffers — the engine's whole reuse story
+    in one number. Run BEFORE the warmup passes so fit 1 is genuinely
+    cold for the boosting path."""
+    from sml_tpu.frame import functions as F
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.feature import Imputer, StringIndexer, VectorAssembler
+    from sml_tpu.xgboost import XgboostRegressor
+
+    idx = [c + "_idx" for c in CAT_COLS]
+    imp = [c + "_imp" for c in NUM_COLS]
+    labeled = train.withColumn("label", F.log(F.col("price")))
+    feats = Pipeline(stages=[
+        Imputer(strategy="median", inputCols=NUM_COLS, outputCols=imp),
+        StringIndexer(inputCols=CAT_COLS, outputCols=idx,
+                      handleInvalid="skip"),
+        VectorAssembler(inputCols=idx + imp, outputCol="features"),
+    ]).fit(labeled).transform(labeled)
+    feats.cache()
+    feats.toPandas()  # featurization outside both timed fits
+    est = XgboostRegressor(n_estimators=40, learning_rate=0.15, max_depth=6,
+                           max_bins=64, random_state=42)
+    t0 = time.perf_counter()
+    est.fit(feats)
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    est.fit(feats)
+    second = time.perf_counter() - t0
+    # release the probe's cached frame before the timed legs (the warm
+    # bin-cache/program entries it leaves behind are the point; a pinned
+    # 800k-row featurized frame is not)
+    feats.unpersist()
+    out = {"first_fit_s": round(first, 3), "second_fit_s": round(second, 3),
+           "speedup": round(first / max(second, 1e-9), 2)}
+    print(f"second-fit probe (identical-shape XGBoost): {out}",
+          file=sys.stderr)
+    return out
+
+
 # ----------------------------------------------------------------- goldens
 def check_goldens(metrics):
     """Compare this run's metric values against the CPU-mesh 1M-row pins
@@ -718,6 +770,11 @@ def main():
     GLOBAL_CONF.set("sml.profiler.enabled", True)
     build_scale_parts()  # data gen + prep OUTSIDE the warmup accounting
 
+    # first/second identical-shape fit in a FRESH process: the quantized
+    # bin cache + program caches + persistent compile cache at work (this
+    # also pre-warms the ml11-shaped programs, shrinking warmup pass 1)
+    sf_probe = second_fit_probe(df.randomSplit([0.8, 0.2], seed=42)[0])
+
     # TWO warmup passes at FULL shapes: pass 1 pays cold compiles, route
     # discovery, and background promotion of the datasets into HBM; pass 2
     # pays the post-promotion device-program compiles. The timed passes then
@@ -764,12 +821,17 @@ def main():
     value = sum(leg_secs.values())
 
     # per-run host re-measure of every cheap leg (same machine, same
-    # session — r4's fairness gap); expensive legs keep the cached anchor
+    # session — r4's fairness gap), best of HOST_TIMED_PASSES to match the
+    # device legs' best-of-3 discipline; expensive legs keep the cached
+    # anchor
     thin = [k for k in leg_secs
             if base.get(k, float("inf")) < HOST_REMEASURE_CUTOFF_S]
-    print(f"re-measuring host baseline for cheap legs: {thin}",
-          file=sys.stderr)
-    fresh = run_host_baseline(pdf, ratings_pdf, only=set(thin))
+    print(f"re-measuring host baseline for cheap legs "
+          f"(best of {HOST_TIMED_PASSES}): {thin}", file=sys.stderr)
+    host_passes = [run_host_baseline(pdf, ratings_pdf, only=set(thin))
+                   for _ in range(HOST_TIMED_PASSES)]
+    fresh = {k: min(p[k] for p in host_passes if k in p)
+             for k in set().union(*host_passes)}
     host_eff = {k: fresh.get(k, base.get(k)) for k in leg_secs}
     base_wall = sum(v for v in host_eff.values() if v is not None)
 
@@ -797,7 +859,10 @@ def main():
                "rows_per_sec": round((N_SCALE if k == "ml_scale"
                                       else N_ROWS) / v, 1),
                "host_baseline_seconds": round(hb, 3) if hb else None,
-               "host_measured": ("this-run" if k in fresh else "cached"),
+               "host_measured": (f"this-run-best-of-{HOST_TIMED_PASSES}"
+                                 if k in fresh else "cached"),
+               "host_seconds_per_pass": ([round(p[k], 3) for p in host_passes
+                                          if k in p] if k in fresh else None),
                "speedup_vs_host": round(hb / v, 2) if hb else None}
         if k in flops:
             leg["device_flops_est"] = flops[k]
@@ -848,7 +913,9 @@ def main():
                   "SF-Airbnb-class, MovieLens-1M ALS, 8M-row scale leg)",
         "definition": "per-leg seconds are the BEST of 3 timed passes "
                       "after 2 warmup passes; value = sum of per-leg "
-                      "best; all per-pass walls/probes recorded here",
+                      "best; re-measured host legs are the BEST of "
+                      f"{HOST_TIMED_PASSES} passes (symmetric discipline); "
+                      "all per-pass walls/probes recorded here",
         "value": round(value, 3),
         "vs_baseline": round(base_wall / value, 3),
         "baseline_seconds_measured_host": round(base_wall, 3),
@@ -868,6 +935,7 @@ def main():
         "probe_spread": {"device": round(spread_dev, 2),
                          "host": round(spread_host, 2)},
         "interference_suspected": interference,
+        "second_fit_probe": sf_probe,
         "golden_ok": golden_ok,
         "golden_drifts": golden_drifts,
         "backend": backend,
@@ -889,6 +957,7 @@ def main():
         "pass_walls": pass_walls,
         "min_leg_speedup": min(v["speedup_vs_host"] for v in per_leg.values()
                                if v["speedup_vs_host"] is not None),
+        "second_fit_speedup": sf_probe["speedup"],
         "interference_suspected": interference,
         "golden_ok": golden_ok,
         "backend": backend,

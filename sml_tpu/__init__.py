@@ -17,34 +17,15 @@ def _enable_persistent_compile_cache() -> None:
     """Point XLA's persistent compilation cache at a repo-local directory so
     a fresh process reuses every program compiled by an earlier one (SURVEY
     §7 hard-part #6: compile+first-exec dominated r2's bench wall-clock).
-    Opt out with SML_TPU_COMPILE_CACHE=0; set it to a path to relocate."""
-    cache = _os.environ.get("SML_TPU_COMPILE_CACHE")
-    if cache == "0":
-        return
-    import jax
-    if not cache:
-        # never override an explicit user choice (env var or pre-import
-        # jax.config call) — only fill in the default
-        if _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-            return
-        try:
-            if jax.config.jax_compilation_cache_dir:
-                return
-        except AttributeError:
-            pass
-        cache = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                              _os.pardir, ".jax_cache")
+    Owned by `parallel.dispatch.ensure_compile_cache` (conf knob
+    `sml.compile.cacheDir`); opt out with SML_TPU_COMPILE_CACHE=0."""
+    # import OUTSIDE the guard: a broken dispatch module must fail the
+    # package import loudly, not silently disable compile caching
+    from .parallel.dispatch import ensure_compile_cache
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          _os.path.abspath(cache))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        # NOT "all": XLA:CPU AOT entries replay with machine-feature
-        # mismatch warnings (pseudo-features like +prefer-no-scatter) and a
-        # documented SIGILL risk; the jax-level executable cache is enough
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+        ensure_compile_cache()
     except Exception:
-        pass  # older jax without these flags: compile caching is best-effort
+        pass  # compile caching is best-effort
 
 
 _enable_persistent_compile_cache()

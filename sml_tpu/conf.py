@@ -78,6 +78,30 @@ _register("sml.fit.foldStackBytes", 1 << 30, int,
           "Byte bound for the fit-time fold-stack memo (stacked CV fold "
           "datasets reused across a tuning grid); independent of the "
           "predict bin cache's budget")
+_register("sml.tree.binCacheBytes", 2 << 30, int,
+          "Device-bytes budget for the quantized bin-index cache (compact "
+          "uint8/uint16 bin matrices staged once per dataset and reused by "
+          "every tree, boosting round, and CV fold); separate from the "
+          "general staging budget so fold stacks cannot evict hot bins")
+_register("sml.tree.roundsPerDispatch", 0, int,
+          "Boosting rounds fused per device dispatch. 0 = the whole "
+          "ensemble in one scan program (default). k > 0 chunks the scan "
+          "into ceil(n_trees/k) dispatches whose margin carry stays in HBM "
+          "with the input buffer DONATED between chunks — bounds compile "
+          "time for very deep ensembles without per-round host transfers")
+_register("sml.compile.cacheDir", "", str,
+          "Persistent XLA compilation-cache directory. Empty = the "
+          "repo-local .jax_cache default (or JAX_COMPILATION_CACHE_DIR / "
+          "SML_TPU_COMPILE_CACHE when set); applied at import and "
+          "re-applied whenever this key is set "
+          "(parallel.dispatch.ensure_compile_cache)")
+_register("sml.split.sortMemoBytes", 1 << 30, int,
+          "Byte bound for randomSplit's pre-split sort memo (each entry "
+          "pins the source partition AND its sorted copy); entries for a "
+          "frame are also dropped by DataFrame.unpersist. Sized like the "
+          "sibling caches so one bench-scale frame's partitions fit — a "
+          "budget below one split's working set makes every later weight "
+          "cell re-sort (FIFO evicts the in-flight split's own entries)")
 _register("sml.cv.batchFolds", False, _to_bool,
           "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
           "map into one vmapped device program for tree regressors. "
@@ -93,6 +117,14 @@ class TpuConf:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._values: Dict[str, Any] = {}
+        self._on_set: Dict[str, Callable[[], None]] = {}
+
+    def on_set(self, key: str, fn: Callable[[], None]) -> None:
+        """Register a callback fired after `key` changes (one per key —
+        used by knobs whose effect must be re-applied to process state,
+        e.g. sml.compile.cacheDir re-pointing the XLA compile cache)."""
+        with self._lock:
+            self._on_set[key] = fn
 
     def set(self, key: str, value: Any) -> None:
         with self._lock:
@@ -104,6 +136,9 @@ class TpuConf:
             alias = _ALIASES.get(key)
             if alias is not None:
                 self._values[alias] = value
+            hook = self._on_set.get(key)
+        if hook is not None:  # outside the lock: hooks may read conf
+            hook()
 
     def get(self, key: str, default: Optional[Any] = None) -> Any:
         with self._lock:

@@ -682,6 +682,9 @@ class DataFrame:
     def unpersist(self) -> "DataFrame":
         # materialization releases the recipe (see _materialize), so data can
         # only be dropped if it is still recomputable
+        if self._parts is not None:
+            from .sampling import drop_sort_memo_for
+            drop_sort_memo_for(self._parts)
         if self._compute is not None:
             self._parts = None
             self._offsets = None

@@ -15,10 +15,12 @@ is a published pure algorithm, reimplemented here without a JVM:
   (core/.../util/random/RandomSampler.scala).
 - The per-partition RNG is `XORShiftRandom` seeded with
   `seed + partitionIndex`, whose init scrambles the seed through
-  MurmurHash3 of its 8 big-endian bytes
-  (core/.../util/random/XORShiftRandom.scala `hashSeed`), and whose
-  `nextDouble` is java.util.Random's two-word construction over the
-  XORShift `next(bits)`.
+  MurmurHash3 of a 64-BYTE buffer — `ByteBuffer.allocate(java.lang.
+  Long.SIZE)` where `Long.SIZE` is 64 *bits*, so Spark actually hashes
+  the 8 big-endian seed bytes followed by 56 zeros, with length-64
+  finalization (core/.../util/random/XORShiftRandom.scala `hashSeed`) —
+  and whose `nextDouble` is java.util.Random's two-word construction
+  over the XORShift `next(bits)`.
 
 Known deviation (documented): our frames store SQL NULL as NaN, so the
 pre-split sort places missing doubles FIRST (pandas na_position) where
@@ -37,9 +39,10 @@ import numpy as np
 import pandas as pd
 
 # ---------------------------------------------------------------- MurmurHash3
-# scala.util.hashing.MurmurHash3.bytesHash over the 8 big-endian bytes of
-# the seed — exactly XORShiftRandom.hashSeed. Words are read little-endian
-# (scala bytesHash); 8 bytes = 2 full words, no tail.
+# scala.util.hashing.MurmurHash3.bytesHash over the buffer Spark builds in
+# XORShiftRandom.hashSeed. Words are read little-endian (scala bytesHash);
+# 64 bytes = 16 full words, no tail. The 56 zero words are NOT no-ops:
+# each word still rotates and remixes h, and finalization xors the length.
 _ARRAY_SEED = 0x3C074A61  # scala.util.hashing.MurmurHash3.arraySeed
 
 _M = 0xFFFFFFFF
@@ -49,10 +52,11 @@ def _rotl(x: int, r: int) -> int:
     return ((x << r) | (x >> (32 - r))) & _M
 
 
-def _mm3_bytes8(data: bytes, seed: int) -> int:
-    """murmur3_x86_32 over exactly 8 bytes (scala bytesHash semantics)."""
+def _mm3_bytes(data: bytes, seed: int) -> int:
+    """murmur3_x86_32 over a word-aligned buffer (scala bytesHash
+    semantics: little-endian words, length-xor finalization)."""
     h = seed & _M
-    for i in (0, 4):
+    for i in range(0, len(data), 4):
         k = int.from_bytes(data[i:i + 4], "little")
         k = (k * 0xCC9E2D51) & _M
         k = _rotl(k, 15)
@@ -60,7 +64,7 @@ def _mm3_bytes8(data: bytes, seed: int) -> int:
         h ^= k
         h = _rotl(h, 13)
         h = (h * 5 + 0xE6546B64) & _M
-    h ^= 8  # finalize with length
+    h ^= len(data)  # finalize with length
     h ^= h >> 16
     h = (h * 0x85EBCA6B) & _M
     h ^= h >> 13
@@ -71,10 +75,13 @@ def _mm3_bytes8(data: bytes, seed: int) -> int:
 
 def hash_seed(seed: int) -> int:
     """XORShiftRandom.hashSeed: two chained MurmurHash3 passes over the
-    seed's 8 big-endian bytes -> 64-bit init state."""
-    data = (seed & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
-    low = _mm3_bytes8(data, _ARRAY_SEED)
-    high = _mm3_bytes8(data, low)
+    64-byte buffer Spark actually hashes — `ByteBuffer.allocate(java.lang.
+    Long.SIZE)` allocates Long.SIZE=64 BYTES (the constant is in bits), so
+    the buffer is the seed's 8 big-endian bytes plus 56 zeros, finalized
+    with length 64 -> 64-bit init state."""
+    data = (seed & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big") + b"\x00" * 56
+    low = _mm3_bytes(data, _ARRAY_SEED)
+    high = _mm3_bytes(data, low)
     return ((high << 32) | low) & 0xFFFFFFFFFFFFFFFF
 
 
@@ -141,8 +148,34 @@ def partition_uniforms(seed: int, partition_index: int, n: int) -> np.ndarray:
 
 
 # ------------------------------------------------------- pre-split local sort
+# id(source pdf) -> (source, sorted, cost_bytes). BYTE-bounded like the
+# repo's other memos (sml.split.sortMemoBytes): each entry strong-refs a
+# full partition AND its sorted copy, so a count-only bound could pin
+# multi-GB of pandas data for the process lifetime.
 _sort_memo: dict = {}
+_sort_memo_bytes: list = [0]
 _sort_lock = threading.Lock()
+
+
+def _pdf_cost(pdf: pd.DataFrame) -> int:
+    """Approximate resident bytes (deep=True counts string payloads —
+    cheap next to the sort this memo amortizes)."""
+    try:
+        return int(pdf.memory_usage(index=True, deep=True).sum())
+    except Exception:
+        return int(pdf.shape[0] * max(pdf.shape[1], 1) * 8)
+
+
+def drop_sort_memo_for(parts) -> None:
+    """Invalidate sort-memo entries sourced from these partition frames
+    (DataFrame.unpersist calls this, so dropping a cached frame actually
+    releases its pre-split sort copies too)."""
+    if not parts:
+        return
+    ids = {id(p) for p in parts}
+    with _sort_lock:
+        for k in [k for k in _sort_memo if k in ids]:
+            _sort_memo_bytes[0] -= _sort_memo.pop(k)[2]
 
 
 def presplit_sort(pdf: pd.DataFrame) -> pd.DataFrame:
@@ -151,7 +184,14 @@ def presplit_sort(pdf: pd.DataFrame) -> pd.DataFrame:
     deterministic regardless of upstream partition materialization.
     Unsortable columns (vector/extension payloads, mixed objects) are
     pruned from the sort order, as Spark prunes unsortable types."""
-    hit = _sort_memo.get(id(pdf))
+    with _sort_lock:
+        hit = _sort_memo.get(id(pdf))
+        if hit is not None and hit[0] is pdf:
+            # LRU touch (dicts iterate in insertion order): a split's
+            # later weight cells re-hit its partitions, so eviction under
+            # byte pressure should fall on stale splits first
+            _sort_memo.pop(id(pdf))
+            _sort_memo[id(pdf)] = hit
     if hit is not None and hit[0] is pdf:
         return hit[1]
     cols = []
@@ -183,9 +223,17 @@ def presplit_sort(pdf: pd.DataFrame) -> pd.DataFrame:
                 out = pdf
     # memoize per partition object: every weight cell of one randomSplit
     # sorts the SAME partition — k cells must not pay k sorts. Strong ref
-    # to the source keeps its id valid; small FIFO bound.
+    # to the source keeps its id valid. LRU within the byte budget; the
+    # NEWEST entry always stays (the split's remaining cells are about to
+    # hit it) even when it alone exceeds the budget.
+    from ..conf import GLOBAL_CONF
+    budget = GLOBAL_CONF.getInt("sml.split.sortMemoBytes")
+    # an unsortable partition memoizes (pdf, pdf): charge the one object
+    cost = _pdf_cost(pdf) + (0 if out is pdf else _pdf_cost(out))
     with _sort_lock:
-        _sort_memo[id(pdf)] = (pdf, out)
-        while len(_sort_memo) > 32:
-            _sort_memo.pop(next(iter(_sort_memo)))
+        if id(pdf) not in _sort_memo:
+            _sort_memo[id(pdf)] = (pdf, out, cost)
+            _sort_memo_bytes[0] += cost
+        while _sort_memo_bytes[0] > budget and len(_sort_memo) > 1:
+            _sort_memo_bytes[0] -= _sort_memo.pop(next(iter(_sort_memo)))[2]
     return out

@@ -18,10 +18,6 @@ from typing import Callable, Optional, Tuple
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
 from ..parallel import dispatch
 from ..parallel import mesh as meshlib
@@ -205,6 +201,65 @@ def _cache_put(key, value):
             _stage_cache_bytes[0] -= old_cost
 
 
+# QUANTIZED BIN-INDEX CACHE (the shared-histogram engine's hot operand):
+# compact uint8/uint16 bin matrices staged ONCE per dataset content and
+# reused by every tree, every boosting round, and every CV fold that
+# re-fits on the same rows. Kept SEPARATE from the general staging cache
+# (its own byte budget, sml.tree.binCacheBytes) so a burst of fold stacks
+# or predict batches cannot evict the bins mid-grid; entries are LRU by
+# touch order.
+_bin_stage_cache: "dict" = {}
+_bin_stage_bytes: list = [0]
+
+
+def _bin_cache_budget() -> int:
+    from ..conf import GLOBAL_CONF
+    return GLOBAL_CONF.getInt("sml.tree.binCacheBytes")
+
+
+def stage_bins_cached(binned: np.ndarray) -> jax.Array:
+    """device_put a quantized bin-index matrix through the bin cache.
+
+    Rows are bucket-padded exactly like `stage_rows_cached`, so aligned
+    per-row arrays (labels, masks) staged through the general cache land
+    on the same padded shape."""
+    from ..utils.profiler import PROFILER
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    a = _normalize(binned)
+    key = (_memo_key(a), id(mesh), "bins", n_dev)
+    with _stage_lock:
+        hit = _bin_stage_cache.get(key)
+        if hit is not None:
+            # move-to-end LRU touch (dicts iterate in insertion order)
+            _bin_stage_cache.pop(key)
+            _bin_stage_cache[key] = hit
+    if hit is not None:
+        PROFILER.count("staging.bin_cache_hit")
+        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
+        return hit
+    padded = meshlib.pad_rows(a, meshlib.bucket_rows(a.shape[0], n_dev))[0]
+    hit = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    with _stage_lock:
+        if key not in _bin_stage_cache:
+            _bin_stage_cache[key] = hit
+            _bin_stage_bytes[0] += hit.nbytes
+            budget = _bin_cache_budget()
+            while _bin_stage_bytes[0] > budget and len(_bin_stage_cache) > 1:
+                old = next(iter(_bin_stage_cache))
+                _bin_stage_bytes[0] -= _bin_stage_cache.pop(old).nbytes
+    PROFILER.count("staging.bin_cache_miss")
+    PROFILER.count("staging.h2d_bytes", padded.nbytes)
+    return hit
+
+
+def bin_cache_stats() -> dict:
+    """(entries, bytes) snapshot — test/debug surface for the bin cache."""
+    with _stage_lock:
+        return {"entries": len(_bin_stage_cache),
+                "bytes": _bin_stage_bytes[0]}
+
+
 def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
     """device_put a row-sharded array through the content cache."""
     from ..utils.profiler import PROFILER
@@ -301,8 +356,12 @@ def _route_mesh(hint, arrays, may_promote: bool = True,
         unstaged = 0.0
         for a in arrays:
             a = _normalize(a)
-            key = (_memo_key(a), id(dev_mesh), kind, n_dev)
-            if key not in _stage_cache:
+            ck = _memo_key(a)
+            key = (ck, id(dev_mesh), kind, n_dev)
+            bkey = (ck, id(dev_mesh), "bins", n_dev)
+            # quantized bin matrices live in their OWN cache (see
+            # stage_bins_cached) — charge H2D only when absent from both
+            if key not in _stage_cache and bkey not in _bin_stage_cache:
                 unstaged += a.nbytes
             keyed.append(a)
         eff = dataclasses.replace(hint,
@@ -313,11 +372,27 @@ def _route_mesh(hint, arrays, may_promote: bool = True,
     if promote and may_promote and keyed \
             and GLOBAL_CONF.getBool("sml.dispatch.autoPromote"):
         for a in keyed:
-            # async put under the device mesh, in the layout the program
-            # will actually read (probing "arr" keys while the program
-            # stages "stack" layouts would promote dead copies)
-            (stage_stacked_cached if stacked else stage_rows_cached)(a)
+            # async put under the device mesh, in the layout AND cache the
+            # program will actually read (probing "arr" keys while the
+            # program stages "stack" layouts would promote dead copies;
+            # likewise a compact bin matrix must land in the bin cache the
+            # tree/predict programs probe, not the general rows cache)
+            if stacked:
+                stage_stacked_cached(a)
+            elif _is_bin_matrix(a):
+                stage_bins_cached(a)
+            else:
+                stage_rows_cached(a)
     return dispatch.host_mesh(), "host"
+
+
+def _is_bin_matrix(a: np.ndarray) -> bool:
+    """The quantized engine's staging discriminator: compact (uint8/uint16)
+    2-D matrices are quantized bin indices — only `tree_impl.bin_dtype`
+    produces them. Wider integer matrices (CompactParts.codes is int32,
+    ALS id columns are 1-D) stay in the general rows cache, so a burst of
+    compact linear fits cannot evict hot bins from the tree budget."""
+    return a.ndim == 2 and a.dtype.kind == "u" and a.dtype.itemsize <= 2
 
 
 @contextlib.contextmanager
@@ -356,9 +431,15 @@ def stage_sharded(*arrays: np.ndarray):
     Results are memoized by content: CV folds, hyperopt trials, and repeated
     fits re-stage identical arrays constantly, and each fresh H2D through
     the device tunnel pays a fixed sync penalty at first use.
+
+    Quantized bin-index matrices (compact uint8/uint16 2-D — see
+    `_is_bin_matrix`) stage through the dedicated bin cache so fit,
+    predict, and eval-pushdown programs share ONE device copy per dataset
+    under its own byte budget.
     """
     n_true = arrays[0].shape[0]
-    outs = [stage_rows_cached(a) for a in arrays]
+    outs = [stage_bins_cached(a) if _is_bin_matrix(np.asarray(a))
+            else stage_rows_cached(a) for a in arrays]
     n_padded = outs[0].shape[0]
     mask_dev = stage_mask_cached(n_padded, n_true)
     return (*outs, mask_dev, n_true)
@@ -373,6 +454,12 @@ def data_parallel(fn: Callable, *, out_replicated: bool = True,
     same reduced value) unless out_replicated=False (then row-sharded).
     Args listed in `replicated_argnums` (rng keys, small parameter vectors)
     are broadcast to every chip instead of row-sharded.
+
+    Donation is deliberately NOT offered here: any input of a
+    data_parallel program may be a staging-cache-owned buffer, and
+    donating one would poison every later cache hit. The one donation
+    site (the chunked boosting scan's margin carry) builds its own
+    shard_map+jit in `tree_impl._compiled_chunk`.
     """
     mesh = meshlib.get_mesh()
     out_spec = P() if out_replicated else P(meshlib.DATA_AXIS)
@@ -384,8 +471,8 @@ def data_parallel(fn: Callable, *, out_replicated: bool = True,
 
     def wrapped(*args):
         specs = tuple(spec_for(i, a) for i, a in enumerate(args))
-        mapped = shard_map(fn, mesh=mesh, in_specs=specs,
-                           out_specs=out_spec, check_vma=False)
+        mapped = meshlib.shard_map_compat(fn, mesh=mesh, in_specs=specs,
+                                          out_specs=out_spec)
         return mapped(*args)
 
     return jax.jit(wrapped)

@@ -213,7 +213,8 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                   bootstrap: bool, subsample: float, seed: int, loss: str,
                   step_size: float = 0.1, reg_lambda: float = 0.0,
                   gamma: float = 0.0, boosting: bool = False,
-                  missing: Optional[float] = None) -> _EnsembleSpec:
+                  missing: Optional[float] = None,
+                  rounds_per_dispatch: Optional[int] = None) -> _EnsembleSpec:
     """The one training path behind every tree learner: bin on host, then
     the WHOLE forest/boosting fit runs as a single on-device program
     (`tree_impl.fit_ensemble_on_device`)."""
@@ -245,7 +246,8 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
             step_size=float(step_size))
         y_dev = stage_aligned(y32, staged.n_padded)
         trees, base = tree_impl.fit_ensemble_on_device(
-            staged.binned_dev, y_dev, staged.mask_dev, es, seed=seed)
+            staged.binned_dev, y_dev, staged.mask_dev, es, seed=seed,
+            rounds_per_dispatch=rounds_per_dispatch)
     mode = "binary" if loss == "logistic" else "regression"
     if boosting:
         weights = np.full(len(trees), step_size, dtype=np.float32)
@@ -372,18 +374,20 @@ def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray,
     finite = np.isfinite(lab)
     l32 = np.where(finite, lab, 0.0).astype(np.float32)
     f32 = finite.astype(np.float32)
-    binned32 = np.ascontiguousarray(binned, dtype=np.int32)
+    # compact quantized dtype preserved: the eval program shares the fit's
+    # bin-cache device copy instead of staging an int32 duplicate
+    binned_q = np.ascontiguousarray(binned)
     hint = dispatch.WorkHint(
         flops=(4.0 * len(spec.trees) * spec.depth + 10.0) * n,
         kind="traverse", out_bytes=64.0)
     from ._staging import routed_for, run_data_parallel
-    with routed_for(hint, binned32, l32, f32) as mesh:
+    with routed_for(hint, binned_q, l32, f32) as mesh:
         if dispatch.is_host_mesh(mesh):
             return None  # host route: ordinary path is cheaper
         from .inference import forest_eval_fn
         sf, sb, lv, w = spec.stacked()
         stats = run_data_parallel(
-            forest_eval_fn(spec.depth, link), binned32, l32, f32,
+            forest_eval_fn(spec.depth, link), binned_q, l32, f32,
             replicated=(np.asarray(sf), np.asarray(sb),
                         np.asarray(lv, dtype=np.float32),
                         np.asarray(w, dtype=np.float32),

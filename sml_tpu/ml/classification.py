@@ -26,9 +26,10 @@ from ._tree_models import (DecisionTreeClassificationModel,
 
 
 class BinaryLogisticRegressionSummary:
-    """Training summary; metrics materialize on first read when built
-    lazily (an 8M-row accuracy/AUC pass costs ~2s the caller may never
-    ask for)."""
+    """Training summary. On the compact fast path the margin and accuracy
+    are computed EAGERLY at fit time (two cheap O(n) sweeps, so the
+    summary closure need not pin the training block); only the
+    O(n log n) AUC sort stays lazy, materializing on first read."""
 
     def __init__(self, accuracy: float = None, areaUnderROC: float = None,
                  numInstances: int = 0, lazy_fn=None):
@@ -38,13 +39,14 @@ class BinaryLogisticRegressionSummary:
         self._lazy_fn = lazy_fn
 
     def _force(self):
-        if self._accuracy is None and self._lazy_fn is not None:
+        if self._lazy_fn is not None:
             self._accuracy, self._auc = self._lazy_fn()
             self._lazy_fn = None
 
     @property
     def accuracy(self) -> float:
-        self._force()
+        if self._accuracy is None:  # an eager value must not force the
+            self._force()           # lazy AUC sort alongside it
         return self._accuracy
 
     @property
@@ -99,14 +101,21 @@ class LogisticRegression(Estimator):
                                             intercept=res.intercept)
             model._inherit_params(self)
 
-            def lazy_metrics(parts=parts, y=y, res=res):
-                margin = parts.predict_affine(res.coefficients,
-                                              res.intercept)
-                pred = (margin > 0).astype(float)
-                return float(np.mean(pred == y)), _fast_auc(margin, y)
+            # margin + accuracy run EAGERLY (two cheap O(n) sweeps) so the
+            # summary closure holds only two 1-D arrays — the previous
+            # closure pinned the full CompactParts block (hundreds of MB
+            # at the 8M-row scale this path is gated to) until the summary
+            # was read, or forever if it never was. Only the O(n log n)
+            # AUC sort stays lazy; all metrics are EXACT full-data values,
+            # and _force drops the arrays once reduced to floats.
+            margin = parts.predict_affine(res.coefficients, res.intercept)
+            acc = float(np.mean(((margin > 0).astype(float)) == y))
+
+            def lazy_metrics(margin=margin, y=y, acc=acc):
+                return acc, _fast_auc(margin, y)
 
             model._summary = BinaryLogisticRegressionSummary(
-                numInstances=len(y), lazy_fn=lazy_metrics)
+                accuracy=acc, numInstances=len(y), lazy_fn=lazy_metrics)
             return model
         else:
             if compact is not None:

@@ -162,9 +162,13 @@ def forest_eval_fn(depth: int, link: str = "identity"):
 
 
 def _stage_rows(X: np.ndarray):
-    from ._staging import stage_mask_cached, stage_rows_cached
-    n_true = np.asarray(X).shape[0]
-    dev = stage_rows_cached(X)
+    from ._staging import (_is_bin_matrix, stage_bins_cached,
+                           stage_mask_cached, stage_rows_cached)
+    X = np.asarray(X)
+    n_true = X.shape[0]
+    # quantized bin matrices ride the shared bin cache: a predict/eval on
+    # rows the fit already staged reuses the fit's device copy verbatim
+    dev = stage_bins_cached(X) if _is_bin_matrix(X) else stage_rows_cached(X)
     mask_dev = stage_mask_cached(dev.shape[0], n_true)
     return dev, mask_dev, n_true
 
@@ -187,8 +191,10 @@ def predict_forest_sharded(binned: np.ndarray, sf: np.ndarray,
                            weights: np.ndarray, depth: int,
                            base: float = 0.0) -> np.ndarray:
     """Stacked-ensemble traversal: rows sharded over the mesh, tree tensors
-    replicated (they are KB-scale), one fused program for the whole forest."""
-    Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, dtype=np.int32))
+    replicated (they are KB-scale), one fused program for the whole forest.
+    `binned` keeps its compact quantized dtype end-to-end (the program
+    widens on-device)."""
+    Bd, mask, n = _stage_rows(np.ascontiguousarray(binned))
     prog = _forest_program(depth)
     out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
                jnp.asarray(lv, dtype=jnp.float32),
@@ -307,7 +313,7 @@ class DeviceScorer:
                 margin = predict_forest(binned, spec.trees, spec.depth,
                                         spec.tree_weights)
             return margin, n, finalize
-        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned, np.int32))
+        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned))
         prog = _forest_program(spec.depth)
         out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
                    jnp.asarray(lv, dtype=jnp.float32),

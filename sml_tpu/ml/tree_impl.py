@@ -65,6 +65,18 @@ class Binning(NamedTuple):
     cat_remap: Dict[int, np.ndarray]  # slot -> category->rank map (label-mean order)
 
 
+def bin_dtype(max_bins: int) -> np.dtype:
+    """Narrowest unsigned dtype holding bin ids in [0, max_bins): the
+    quantized engine ships and keeps bin matrices COMPACT (uint8 at the
+    default maxBins ≤ 256 — 4x less H2D traffic and HBM residency than the
+    int32 matrices the seed staged), widening only when maxBins demands."""
+    if max_bins <= (1 << 8):
+        return np.dtype(np.uint8)
+    if max_bins <= (1 << 16):
+        return np.dtype(np.uint16)
+    return np.dtype(np.int32)
+
+
 def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
               categorical: Optional[Dict[int, int]] = None,
               max_categories_error: bool = True) -> Tuple[np.ndarray, Binning]:
@@ -115,19 +127,29 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
             qs = np.unique(qs.astype(np.float32))
             edges[f, :len(qs)] = qs
             edge_list[f] = qs
-    binned = _bin_columns(X, edge_list, remaps)
+    # dtype must hold the categorical ranks too: with
+    # max_categories_error=False a cardinality may legally exceed
+    # max_bins, and a uint8 matrix would silently wrap those ranks
+    need = max([max_bins] + [len(r) for r in remaps.values()])
+    binned = _bin_columns(X, edge_list, remaps, bin_dtype(need))
     return binned, Binning(edges=edges, cat_remap=remaps)
 
 
-def _bin_columns(X: np.ndarray, edge_list, remaps: Dict[int, np.ndarray]) -> np.ndarray:
+def _bin_columns(X: np.ndarray, edge_list, remaps: Dict[int, np.ndarray],
+                 out_dtype=np.int32) -> np.ndarray:
     """Full-column discretization against known edges/remaps: the threaded
     C++ kernel (`native/binning.cc`) when available, NumPy otherwise —
-    identical semantics (searchsorted 'left'; non-finite → bin 0)."""
+    identical semantics (searchsorted 'left'; non-finite → bin 0).
+    `out_dtype` is the quantized engine's compact storage dtype (see
+    `bin_dtype`); callers size it over max_bins AND every categorical
+    cardinality, so all bin ids fit by construction."""
     from ..native import binning as _native_binning
     n, F = X.shape
     binned = _native_binning.bin_continuous(X, edge_list, remaps)
-    if binned is None:
-        binned = np.zeros((n, F), dtype=np.int32)
+    if binned is not None:
+        binned = binned.astype(out_dtype, copy=False)
+    else:
+        binned = np.zeros((n, F), dtype=out_dtype)
         for f in range(F):
             if f in remaps:
                 continue
@@ -136,7 +158,7 @@ def _bin_columns(X: np.ndarray, edge_list, remaps: Dict[int, np.ndarray]) -> np.
                 continue
             col = X[:, f]
             binned[:, f] = np.searchsorted(qs, col,
-                                           side="left").astype(np.int32)
+                                           side="left").astype(out_dtype)
             binned[~np.isfinite(col), f] = 0  # missing → lowest bin
     for f, rank in remaps.items():
         ids = np.clip(X[:, f].astype(np.int64), 0, len(rank) - 1)
@@ -178,7 +200,13 @@ def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
         return hit
     edge_list = [binning.edges[f][np.isfinite(binning.edges[f])]
                  for f in range(X.shape[1])]
-    out = _bin_columns(Xn, edge_list, binning.cat_remap)
+    # compact dtype keyed by the model's maxBins (edges carry B-1 slots)
+    # AND its categorical cardinalities (which may exceed maxBins when the
+    # guard was suppressed at fit time) — predict-time matrices ride the
+    # same quantized representation as fit, never wrapping a rank
+    need = max([binning.edges.shape[1] + 1]
+               + [len(r) for r in binning.cat_remap.values()])
+    out = _bin_columns(Xn, edge_list, binning.cat_remap, bin_dtype(need))
     from ..conf import GLOBAL_CONF
     max_bytes = GLOBAL_CONF.getInt("sml.predict.binCacheBytes")
     with _predict_bin_lock:
@@ -407,30 +435,46 @@ class EnsembleSpec(NamedTuple):
 _ensemble_cache: Dict[EnsembleSpec, object] = {}
 
 
-def _make_ensemble_program(es: EnsembleSpec):
-    """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
-    trees, margins and sampling weights living in HBM for the entire fit.
-    One dispatch + one packed device→host transfer per ensemble — the
-    per-tree host round-trips (expensive over a TPU tunnel) disappear."""
+def _base_margin_fn(loss: str):
+    """Per-chip base-margin statistic (mean / log-odds of the masked
+    labels) with ONE fused allreduce for both sufficient statistics —
+    shared by the monolithic ensemble program and the chunked boosting
+    path's standalone base program, so both produce bit-identical bases."""
+    def base_fn(y, mask):
+        n_tot, y_tot = coll.psum_scalars(jnp.sum(mask), jnp.sum(y * mask))
+        if loss == "logistic":
+            p0 = jnp.clip(y_tot / n_tot, 1e-6, 1 - 1e-6)
+            return jnp.log(p0 / (1 - p0))
+        return y_tot / n_tot
+    base_fn.__name__ = f"tree_base_{loss}"
+    return base_fn
+
+
+def _ensemble_pieces(es: EnsembleSpec):
+    """The shared internals of every ensemble program shape: `prepare`
+    widens the compact quantized bins on-device and hoists the one-hot
+    transpose; `make_round` returns the per-round scan body. Factored so
+    the monolithic program and the chunked boosting program are the SAME
+    math — a parity test holds them together."""
     spec = es.tree
     hist_dtype = _hist_dtype()
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
-    D, B, F = spec.max_depth, spec.n_bins, spec.n_features
+    B, F = spec.n_bins, spec.n_features
 
-    def program(binned, y, mask, rng):
+    def prepare(binned, rng):
         n = binned.shape[0]
+        # compact uint8/uint16 bins widen ON-DEVICE (a fused VPU cast over
+        # the 4x-smaller staged matrix), never on the host/H2D path
+        binned = binned.astype(jnp.int32)
         B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
             .reshape(n, F * B).T  # transposed ONCE, reused by every tree
-        key = jax.random.wrap_key_data(rng)
         # per-chip sampling streams must differ: fold in the shard index
-        key = jax.random.fold_in(key, coll.axis_index())
-        n_tot = coll.psum(jnp.sum(mask))
-        if es.loss == "logistic":
-            p0 = jnp.clip(coll.psum(jnp.sum(y * mask)) / n_tot, 1e-6, 1 - 1e-6)
-            base = jnp.log(p0 / (1 - p0))
-        else:
-            base = coll.psum(jnp.sum(y * mask)) / n_tot
-        margin0 = jnp.full((n,), base, dtype=jnp.float32)
+        key = jax.random.fold_in(jax.random.wrap_key_data(rng),
+                                 coll.axis_index())
+        return binned, B1t, key
+
+    def make_round(binned, B1t, y, mask, key, rng):
+        n = binned.shape[0]
 
         def round_fn(margin, t):
             if es.boosting:
@@ -461,15 +505,111 @@ def _make_ensemble_program(es: EnsembleSpec):
                 margin = margin + es.step_size * pack[2][node_fin]
             return margin, pack
 
+        return round_fn
+
+    return prepare, make_round
+
+
+def _make_ensemble_program(es: EnsembleSpec):
+    """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
+    trees, margins and sampling weights living in HBM for the entire fit.
+    One dispatch + one packed device→host transfer per ensemble — the
+    per-tree host round-trips (expensive over a TPU tunnel) disappear."""
+    prepare, make_round = _ensemble_pieces(es)
+    base_of = _base_margin_fn(es.loss)
+
+    def program(binned, y, mask, rng):
+        binned, B1t, key = prepare(binned, rng)
+        base = base_of(y, mask)
+        margin0 = jnp.full((binned.shape[0],), base, dtype=jnp.float32)
+        round_fn = make_round(binned, B1t, y, mask, key, rng)
         _, packs = jax.lax.scan(round_fn, margin0, jnp.arange(es.n_trees))
         return packs, base
 
     return program
 
 
+def _make_chunk_program(es: EnsembleSpec, chunk: int):
+    """`chunk` boosting rounds as one dispatch: the margin carry enters and
+    leaves as a row-sharded HBM buffer (donated between dispatches by the
+    caller), `t0` offsets the round index so sampling streams and feature
+    subspaces match the monolithic scan round-for-round."""
+    prepare, make_round = _ensemble_pieces(es)
+
+    def program(binned, y, mask, margin, rng, t0):
+        binned, B1t, key = prepare(binned, rng)
+        round_fn = make_round(binned, B1t, y, mask, key, rng)
+        margin, packs = jax.lax.scan(
+            round_fn, margin, t0 + jnp.arange(chunk, dtype=jnp.int32))
+        return margin, packs
+
+    return program
+
+
+_chunk_cache: Dict[tuple, object] = {}
+_base_prog_cache: Dict[tuple, object] = {}
+
+
+def _compiled_chunk(es: EnsembleSpec, chunk: int):
+    from ..parallel import mesh as _meshlib
+    from ..conf import GLOBAL_CONF
+    mesh = _meshlib.get_mesh()
+    # donate the margin carry so chunk k+1 reuses chunk k's HBM (the
+    # chain's only fresh buffer — bins/labels/mask stay cache-owned
+    # and are never donated); XLA:CPU ignores donation, so skip it
+    # there to avoid the unused-donation warning. The donate decision is
+    # part of the cache key: toggling sml.tpu.donate must not replay a
+    # program compiled under the other setting.
+    plat = list(mesh.devices.flat)[0].platform
+    donate = (3,) if plat != "cpu" \
+        and GLOBAL_CONF.getBool("sml.tpu.donate") else ()
+    key = (es, chunk, id(mesh), _hist_subtract(), donate)
+    if key not in _chunk_cache:
+        program = _make_chunk_program(es, chunk)
+        P = jax.sharding.PartitionSpec
+        Dx = _meshlib.DATA_AXIS
+        wrapped = _meshlib.shard_map_compat(
+            program, mesh=mesh,
+            in_specs=(P(Dx, None), P(Dx), P(Dx), P(Dx), P(), P()),
+            out_specs=(P(Dx), P()))
+        _chunk_cache[key] = jax.jit(wrapped, donate_argnums=donate)
+    return _chunk_cache[key]
+
+
+def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
+                          seed: int, chunk: int):
+    """Boosting rounds in ceil(n_trees/chunk) dispatches. The margin never
+    visits the host between chunks — it carries as a donated device buffer
+    — and per-chunk tree packs are fetched once at the end (one batched
+    D2H). Bit-identical to the monolithic program on equal backends."""
+    from ..parallel import mesh as _meshlib
+    mesh = _meshlib.get_mesh()
+    bkey = (es.loss, id(mesh))
+    if bkey not in _base_prog_cache:
+        _base_prog_cache[bkey] = data_parallel(_base_margin_fn(es.loss))
+    base = float(jax.device_get(_base_prog_cache[bkey](y_dev, mask_dev)))
+    margin = jax.device_put(
+        np.full((binned_dev.shape[0],), base, np.float32),
+        _meshlib.data_sharding(mesh, 1))
+    rng = jax.random.key_data(jax.random.PRNGKey(seed))
+    packs_parts = []
+    t0 = 0
+    while t0 < es.n_trees:
+        c = min(chunk, es.n_trees - t0)
+        margin, packs = _compiled_chunk(es, c)(
+            binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
+        packs_parts.append(packs)
+        t0 += c
+    packs = np.concatenate(jax.device_get(packs_parts), axis=0)
+    return _unpack_trees(packs), base
+
+
 def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
-                           seed: int = 0):
-    """Run the whole-ensemble program; returns (trees, base)."""
+                           seed: int = 0,
+                           rounds_per_dispatch: Optional[int] = None):
+    """Run the whole-ensemble program; returns (trees, base).
+    `rounds_per_dispatch` overrides sml.tree.roundsPerDispatch (the
+    sparkdl.xgboost surface exposes it per-estimator)."""
     from ..parallel import dispatch as _dispatch
     from ..parallel import mesh as _meshlib
     from ..utils.profiler import PROFILER
@@ -477,12 +617,20 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
             "program.tree_ensemble", rows=int(binned_dev.shape[0]),
             route="host" if _dispatch.is_host_mesh(_meshlib.get_mesh())
             else "device", trees=es.n_trees):
-        return _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es, seed)
+        return _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es, seed,
+                                       rounds_per_dispatch)
 
 
 def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
-                            seed: int = 0):
+                            seed: int = 0,
+                            rounds_per_dispatch: Optional[int] = None):
     from ..parallel import mesh as _meshlib
+    from ..conf import GLOBAL_CONF
+    rounds = (rounds_per_dispatch if rounds_per_dispatch is not None
+              else GLOBAL_CONF.getInt("sml.tree.roundsPerDispatch"))
+    if es.boosting and 0 < rounds < es.n_trees:
+        return _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es,
+                                     seed, rounds)
     key = (es, id(_meshlib.get_mesh()), _hist_subtract())
     if key not in _ensemble_cache:
         _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
@@ -587,16 +735,12 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
             return jax.vmap(program, in_axes=(0, 0, 0, None))(
                 binned_f, y_f, mask_f, rng)
 
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
         P = jax.sharding.PartitionSpec
         D = _meshlib.DATA_AXIS
-        wrapped = shard_map(
+        wrapped = _meshlib.shard_map_compat(
             batched, mesh=mesh,
             in_specs=(P(None, D, None), P(None, D), P(None, D), P()),
-            out_specs=(P(), P()), check_vma=False)
+            out_specs=(P(), P()))
         _folds_cache[key] = jax.jit(wrapped)
     compiled = _folds_cache[key]
 
@@ -616,6 +760,7 @@ def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32):
 
     def program(binned, grad, hess, weight, feat_rng):
         n = binned.shape[0]
+        binned = binned.astype(jnp.int32)  # compact bins widen on-device
         B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype).reshape(n, F * B).T
         pack, _ = build(B1t, binned, grad, hess, weight, feat_rng)
         return (pack[0].astype(jnp.int32), pack[1].astype(jnp.int32),
@@ -654,6 +799,7 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
 @partial(jax.jit, static_argnames=("depth",))
 def _predict_binned(binned, split_feature, split_bin, leaf_value, depth: int):
     n = binned.shape[0]
+    binned = binned.astype(jnp.int32)  # compact bins widen on-device
     node = jnp.zeros((n,), dtype=jnp.int32)
     for _ in range(depth):
         f = split_feature[node]
@@ -719,7 +865,10 @@ def stage_tree_data(X: np.ndarray, y: np.ndarray, max_bins: int,
                     prebinned=None) -> StagedData:
     """`prebinned=(binned, binning)` skips re-binning when the caller
     already discretized (it bins BEFORE routing so the dispatcher can probe
-    the staging cache with the actual device operand)."""
+    the staging cache with the actual device operand). The compact
+    quantized matrix stages through the shared bin cache (`stage_sharded`
+    routes 2-D integer matrices there), so every tree, boosting round, CV
+    fold, and eval pushdown on the same rows reuses ONE device copy."""
     if prebinned is not None:
         binned, binning = prebinned
     else:

@@ -6,7 +6,10 @@
 //   nextDouble: ((next(26) << 27) + next(27)) * 2^-53
 // (org/apache/spark/util/random/XORShiftRandom.scala). The caller passes
 // the ALREADY-HASHED seed (XORShiftRandom.hashSeed of seed+partitionIndex
-// — see frame/sampling.py, which owns the MurmurHash3 seed scramble).
+// — see frame/sampling.py, which owns the MurmurHash3 seed scramble over
+// Spark's 64-BYTE buffer: ByteBuffer.allocate(java.lang.Long.SIZE) where
+// Long.SIZE is 64 bits, i.e. 8 big-endian seed bytes + 56 zeros hashed
+// with length-64 finalization).
 
 #include <cstdint>
 

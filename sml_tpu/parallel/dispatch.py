@@ -49,6 +49,72 @@ _register("sml.dispatch.autoPromote", True, _to_bool,
           "device-resident copy would beat the host, so repeated fits "
           "(CV folds, tuning trials) converge onto the chip")
 
+
+# --------------------------------------------------- persistent compile cache
+# Two layers keep repeated fits (and the bench warmup) from recompiling:
+# 1. shape-bucketed padding — `mesh.bucket_rows` (re-exported below) rounds
+#    row counts onto a coarse grid (≤12.5% padding) so near-size datasets
+#    (CV folds, randomSplit variants, tuning re-fits) hit the SAME compiled
+#    program signature;
+# 2. XLA's persistent compilation cache — a fresh process replays earlier
+#    compiles from disk instead of re-running XLA.
+bucket_rows = meshlib.bucket_rows
+
+_compile_cache_state = {"dir": None}
+
+
+def ensure_compile_cache() -> Optional[str]:
+    """Point XLA's persistent compilation cache at `sml.compile.cacheDir`
+    (conf), falling back to SML_TPU_COMPILE_CACHE / JAX_COMPILATION_CACHE_DIR
+    env and then the repo-local .jax_cache default. Idempotent; returns the
+    active directory (None = caching disabled or unsupported jax).
+
+    Called at package import, and again automatically whenever
+    `sml.compile.cacheDir` is set (a conf on_set hook — jax reads the
+    config per compile, so later programs land in the new directory)."""
+    import os
+    conf_dir = str(GLOBAL_CONF.get("sml.compile.cacheDir") or "").strip()
+    cache = conf_dir or os.environ.get("SML_TPU_COMPILE_CACHE")
+    if cache == "0":
+        return None
+    import jax
+    if not cache:
+        # never override an explicit user choice (env var or pre-import
+        # jax.config call) — only fill in the default. A jax config value
+        # WE latched earlier is ours to re-point (clearing the conf knob
+        # restores the default).
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            return os.environ["JAX_COMPILATION_CACHE_DIR"]
+        try:
+            current = jax.config.jax_compilation_cache_dir
+            if current and current != _compile_cache_state["dir"]:
+                return current
+        except AttributeError:
+            pass
+        here = os.path.dirname(os.path.abspath(__file__))
+        cache = os.path.join(here, os.pardir, os.pardir, ".jax_cache")
+    cache = os.path.abspath(cache)
+    if _compile_cache_state["dir"] == cache:
+        return cache
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # NOT "all": XLA:CPU AOT entries replay with machine-feature
+        # mismatch warnings (pseudo-features like +prefer-no-scatter) and a
+        # documented SIGILL risk; the jax-level executable cache is enough
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except Exception:
+        return None  # older jax without these flags: best-effort
+    _compile_cache_state["dir"] = cache
+    return cache
+
+
+# setting the knob re-points the cache immediately (without this hook the
+# import-time call would latch the default and the conf key would be dead)
+GLOBAL_CONF.on_set("sml.compile.cacheDir",
+                   lambda: ensure_compile_cache())
+
 # effective host rates (elementwise ops/s) per program family — the
 # BOOTSTRAP values only: every hinted host execution feeds its measured
 # flops/sec back into OBSERVED_HOST below, so routing converges onto this

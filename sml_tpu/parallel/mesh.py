@@ -21,6 +21,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"    # row / batch parallelism (Spark partitions → chips)
 MODEL_AXIS = "model"  # feature/block parallelism (Gram blocks, ALS factors)
 
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """`shard_map` across jax versions, replication checking OFF — the one
+    spelling every program wrapper uses. Newer jax exposes top-level
+    `jax.shard_map(..., check_vma=...)`; 0.4.x has only
+    `jax.experimental.shard_map.shard_map(..., check_rep=...)`. Passing the
+    wrong kwarg is a TypeError, so the flag name is chosen by probing the
+    import, not by try/except around the call."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
 _lock = threading.RLock()
 _active_mesh: Optional[Mesh] = None
 _tls = threading.local()  # per-thread mesh override (trial placement)

@@ -8,6 +8,16 @@ boosting runs as the jitted mesh program in `sml_tpu.ml.tree_impl`, whose
 per-level reduction is one psum over ICI — `tpu_hist`, the `gpu_hist`
 equivalent named in SURVEY §2.2 P9. `num_workers` maps to mesh data-shards;
 `use_gpu`/`device` is accepted for surface parity ('tpu' is the only engine).
+
+Quantized shared-histogram engine (the GPU boosting design of
+arXiv:1806.11248 mapped to the mesh): features quantize ONCE into a compact
+uint8/uint16 bin-index matrix, content-cached on device
+(`ml/_staging.stage_bins_cached`, budget `sml.tree.binCacheBytes`) and
+reused by every boosting round, every tree, and every CV fold. Boosting
+rounds scan entirely on-device; `rounds_per_dispatch` (or the
+`sml.tree.roundsPerDispatch` conf) chunks the scan into multiple dispatches
+whose margin carry stays in HBM with the buffer DONATED between chunks —
+no per-round host↔device transfers either way.
 """
 
 from __future__ import annotations
@@ -39,6 +49,10 @@ class _XgboostParams:
         self._declareParam("use_gpu", default=False, doc="accepted for surface parity")
         self._declareParam("device", default="tpu", doc="compute engine")
         self._declareParam("tree_method", default="tpu_hist", doc="histogram engine")
+        self._declareParam("rounds_per_dispatch", default=None,
+                           doc="boosting rounds fused per device dispatch "
+                               "(None = sml.tree.roundsPerDispatch conf; "
+                               "0 = whole ensemble in one scan program)")
 
 
 class _XgboostBase(_TreeEstimatorBase, _XgboostParams):
@@ -76,7 +90,10 @@ class _XgboostBase(_TreeEstimatorBase, _XgboostParams):
             step_size=float(self.getOrDefault("learning_rate")),
             reg_lambda=float(self.getOrDefault("reg_lambda")),
             gamma=float(self.getOrDefault("gamma")), boosting=True,
-            missing=float(self.getOrDefault("missing")))
+            missing=float(self.getOrDefault("missing")),
+            rounds_per_dispatch=(
+                None if self.getOrDefault("rounds_per_dispatch") is None
+                else int(self.getOrDefault("rounds_per_dispatch"))))
         m = self._model_cls(spec)
         m._inherit_params(self)
         return m

@@ -25,14 +25,13 @@ assert jax.device_count() == 2, jax.device_count()
 # a psum across BOTH processes' devices: each contributes (pid+1)
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from sml_tpu.parallel.mesh import shard_map_compat
 mesh = Mesh(np.asarray(jax.devices()), ("data",))
 local = np.asarray([float(pid + 1)])
 arr = jax.make_array_from_process_local_data(
     NamedSharding(mesh, P("data")), local, (2,))
-f = jax.jit(shard_map(lambda x: collectives.psum(x, "data"), mesh=mesh,
-                      in_specs=P("data"), out_specs=P(),
-                      check_vma=False))
+f = jax.jit(shard_map_compat(lambda x: collectives.psum(x, "data"),
+                             mesh=mesh, in_specs=P("data"), out_specs=P()))
 out = f(arr)
 total = float(np.asarray(jax.device_get(out.addressable_shards[0].data))[0])
 assert total == 3.0, total  # 1 + 2 over DCN
@@ -55,6 +54,19 @@ def test_initialize_multihost_two_process_psum(tmp_path):
     for p in procs:
         out, _ = p.communicate(timeout=180)
         outs.append(out)
+    capability = ("Multiprocess computations aren't implemented on the "
+                  "CPU backend")
+    # skip ONLY when the capability gap explains every failure: a worker
+    # that died for any other reason must still fail the test, even if
+    # its sibling hit the capability message
+    other_failures = [pid for pid, (p, out) in enumerate(zip(procs, outs))
+                      if p.returncode != 0 and capability not in out]
+    if any(capability in out for out in outs) and not other_failures:
+        # this jaxlib's CPU client cannot run cross-process computations
+        # at all (capability, not a wiring bug — the bootstrap itself
+        # succeeded if both workers got as far as the psum dispatch)
+        import pytest
+        pytest.skip("jaxlib CPU backend lacks multiprocess computations")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert "psum-over-hosts ok: 3.0" in out

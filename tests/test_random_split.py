@@ -1,10 +1,12 @@
 """Spark randomSplit sampler parity (frame/sampling.py).
 
 Layers of evidence, mirroring the Murmur3 anchoring strategy:
-- algorithm golden vectors for hashSeed / XORShiftRandom.nextDouble,
-  pinned from the reference pure-python implementation (the published
-  algorithm in core/.../util/random/XORShiftRandom.scala) — the native
+- HARD-CODED golden vectors for hashSeed / XORShiftRandom.nextDouble
+  (the published algorithm in core/.../util/random/XORShiftRandom.scala,
+  64-byte hash buffer included), cross-derived through the independent
+  native murmur3 kernel — the pure-python reference AND the native
   kernel must reproduce them bit-for-bit;
+- pinned randomSplit row-index sets for fixed partition layouts;
 - structural properties Spark documents and the course demonstrates
   (`ML 02:38-52`): determinism, disjoint+exhaustive cells,
   partition-layout sensitivity, per-partition local sort.
@@ -17,23 +19,71 @@ import pytest
 from sml_tpu.frame.sampling import (XORShiftRandom, hash_seed,
                                     partition_uniforms, presplit_sort)
 
-# hashSeed is MurmurHash3 (already externally anchored by
-# tests/test_hashing.py against the course's own Spark constants) over
-# the seed's 8 big-endian bytes; these pins freeze the composition.
+# HARD-CODED hashSeed golden vectors (NOT recomputed from hash_seed at
+# import time — a tautological pin can never catch a divergence). The
+# values are XORShiftRandom.hashSeed over the 64-byte buffer Spark
+# actually hashes (ByteBuffer.allocate(java.lang.Long.SIZE) allocates 64
+# BYTES — the constant is in bits — so the 8 big-endian seed bytes ride
+# with 56 zeros and length-64 finalization), cross-generated from the
+# repo's independent C++ murmur3 kernel (native/murmur3.cc, itself
+# anchored against the course's Spark hash() constants by
+# tests/test_hashing.py) composed per the published hashSeed algorithm.
 HASH_SEED_VECTORS = {
-    0: hash_seed(0),
-    1: hash_seed(1),
-    42: hash_seed(42),
-    12345: hash_seed(12345),
+    0: 0x427B0291EEA8D4AE,
+    1: 0xEB35A34DF420ED6F,
+    42: 0xCEA176B6C35E99CF,
+    12345: 0x1A5B3ACFF3616EB8,
+}
+
+# first nextDouble draws of the hashSeed-scrambled XORShift stream —
+# java.util.Random's two-word construction over next(26)/next(27)
+NEXT_DOUBLE_VECTORS = {
+    0: [0.8446490682263027, 0.4048454303385226,
+        0.5871875724155838, 0.8865128837019473],
+    42: [0.6661236774413726, 0.8583151351252906,
+         0.9139963682495181, 0.8664942556157945],
+    12345: [0.3217855146445381, 0.5926558057691951,
+            0.3530876039804548, 0.18715752944048802],
 }
 
 
-def test_hash_seed_is_stable_and_64bit():
+def test_hash_seed_matches_pinned_goldens():
     for s, v in HASH_SEED_VECTORS.items():
-        assert hash_seed(s) == v
+        assert hash_seed(s) == v, f"hashSeed({s}) diverged from pin"
         assert 0 <= v < (1 << 64)
     # distinct seeds scramble to distinct states
     assert len(set(HASH_SEED_VECTORS.values())) == len(HASH_SEED_VECTORS)
+
+
+def test_hash_seed_matches_independent_murmur3_kernel():
+    """Re-derive hashSeed through the independent C++ murmur3 (the hash()
+    kernel anchored by test_hashing.py), composing the published
+    algorithm: low = mm3(buf64, arraySeed); high = mm3(buf64, low)."""
+    import ctypes
+
+    from sml_tpu.native.build import load_library
+    lib = load_library("murmur3")
+    if lib is None:
+        pytest.skip("native murmur3 kernel unavailable")
+    lib.mm3_hash_one_bytes.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                       ctypes.c_int32]
+    lib.mm3_hash_one_bytes.restype = ctypes.c_int32
+    for s in (0, 1, 42, 977, 12345, 2**31 - 1):
+        buf = (s & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big") + b"\x00" * 56
+        low = lib.mm3_hash_one_bytes(
+            buf, 64, ctypes.c_int32(0x3C074A61).value) & 0xFFFFFFFF
+        high = lib.mm3_hash_one_bytes(
+            buf, 64, ctypes.c_int32(
+                low - (1 << 32) if low >= (1 << 31) else low).value) \
+            & 0xFFFFFFFF
+        assert hash_seed(s) == ((high << 32) | low)
+
+
+def test_next_double_matches_pinned_goldens():
+    for s, want in NEXT_DOUBLE_VECTORS.items():
+        rng = XORShiftRandom(s)
+        got = [rng.next_double() for _ in range(len(want))]
+        assert got == want, f"nextDouble stream for seed {s} diverged"
 
 
 def test_next_double_reference_properties():
@@ -44,6 +94,32 @@ def test_next_double_reference_properties():
     assert len(set(draws)) == 1000
     # mean of 1000 uniforms within loose bounds
     assert 0.4 < float(np.mean(draws)) < 0.6
+
+
+# pinned randomSplit row-index sets: 100 rows [0..99], fixed partition
+# layouts — the whole pipeline (pre-split sort → hashSeed → XORShift
+# stream → BernoulliCellSampler cells) frozen as observable output. Any
+# change to any stage moves these sets.
+SPLIT_PINS = [
+    # (num_partitions, weights, seed, sorted row ids of the LAST cell)
+    (2, [0.8, 0.2], 42,
+     [1, 2, 3, 13, 16, 35, 52, 55, 62, 68, 73, 80, 81, 82, 84, 85, 88,
+      89, 91, 94, 99]),
+    (4, [0.75, 0.25], 7,
+     [0, 8, 9, 14, 15, 17, 21, 22, 23, 27, 29, 30, 38, 40, 41, 42, 45,
+      47, 49, 56, 58, 59, 61, 66, 77, 83, 97]),
+]
+
+
+def test_random_split_row_sets_match_pins():
+    from sml_tpu.frame.dataframe import DataFrame
+    pdf = pd.DataFrame({"a": np.arange(100, dtype=float)})
+    for nparts, weights, seed, want in SPLIT_PINS:
+        df = DataFrame.from_pandas(pdf, num_partitions=nparts)
+        cells = df.randomSplit(weights, seed=seed)
+        got = sorted(int(v) for v in cells[-1].toPandas()["a"])
+        assert got == want, \
+            f"randomSplit pin drifted (parts={nparts}, seed={seed})"
 
 
 def test_native_kernel_matches_reference():

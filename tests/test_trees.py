@@ -222,7 +222,19 @@ def test_hist_subtraction_matches_direct(spark):
             for ta, tb in zip(specs[False].trees, specs[True].trees):
                 np.testing.assert_array_equal(ta.split_feature,
                                               tb.split_feature)
-                np.testing.assert_array_equal(ta.split_bin, tb.split_bin)
+                # split bins must agree EXCEPT where the two candidates'
+                # gains tie within f32 cancellation noise (parent-minus-
+                # left accumulates last-ulp error that can flip an argmax
+                # between score-equal thresholds; which ties flip varies
+                # with the XLA version's fusion choices)
+                diff = np.flatnonzero(ta.split_bin != tb.split_bin)
+                assert len(diff) <= max(1, len(ta.split_bin) // 50), \
+                    f"{len(diff)} split bins differ: beyond tie noise"
+                for node in diff:
+                    ga, gb = float(ta.gain[node]), float(tb.gain[node])
+                    assert abs(ga - gb) <= 1e-3 * max(1.0, abs(ga)), \
+                        f"node {node}: differing split bins with " \
+                        f"non-tied gains {ga} vs {gb}"
                 np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
                                            atol=1e-3)
     finally:
