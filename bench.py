@@ -26,8 +26,10 @@ Legs (every BASELINE.json config):
 Output contract (VERDICT r4 #2): the LAST stdout line is a SHORT headline
 JSON — {metric, value, unit, vs_baseline, compile_seconds, pass_walls,
 interference_suspected, golden_ok, backend, legs_file} — sized to survive
-any capture tail window. Per-leg detail, probes, and metrics go to the
-`bench_legs.json` sidecar and stderr.
+any capture tail window. Per-leg detail, probes, metrics, and each leg's
+ENGINE-COUNTER deltas (staging bytes, cache hits, shuffle volume,
+compile count — see docs/OBSERVABILITY.md) go to the `bench_legs.json`
+sidecar and stderr.
 
 Timing policy: THREE timed passes after two full warmup passes; each
 leg's reported seconds is its BEST across the timed passes (every pass's
@@ -99,6 +101,28 @@ GOLDEN_TOLERANCES = {
 }
 
 
+class EngineCounterTrack:
+    """Per-leg engine-counter deltas (staging bytes, cache hits, shuffle
+    volume, compile count) from the profiler's counter stream: `mark(leg)`
+    attributes everything counted since the previous mark to `leg`.
+    Recorded into the bench_legs.json sidecar so BENCH runs carry
+    cache-hit/byte-volume trajectories alongside wall time — a perf PR can
+    diff engine behavior, not just seconds."""
+
+    def __init__(self):
+        from sml_tpu.utils.profiler import PROFILER
+        self._prof = PROFILER
+        self._prev = PROFILER.counters()
+        self.legs = {}
+
+    def mark(self, leg):
+        cur = self._prof.counters()
+        delta = {k: round(v - self._prev.get(k, 0.0), 3)
+                 for k, v in cur.items() if v != self._prev.get(k, 0.0)}
+        self.legs[leg] = delta
+        self._prev = cur
+
+
 def build_dataset(n):
     from sml_tpu.courseware import make_airbnb_dataset
     from sml_tpu.frame.session import get_session
@@ -159,7 +183,7 @@ def build_scale_parts():
     return parts, yp, yl
 
 
-def run_scale_leg(timings, flops, metrics):
+def run_scale_leg(timings, flops, metrics, eng=None):
     """8M-row LinearRegression + LogisticRegression through the compact
     expand-on-device programs (`linear_impl.fit_*_compact`): one Gram
     dispatch + one fused-IRLS dispatch, one-hot slots expanded on-chip.
@@ -175,6 +199,8 @@ def run_scale_leg(timings, flops, metrics):
                                               maxIter=SCALE_LOGIT_ITERS,
                                               tol=1e-9)
     timings["ml_scale"] = time.perf_counter() - t0
+    if eng is not None:
+        eng.mark("ml_scale")
     flops["ml_scale"] = (2.0 * n8 * (d + 1) ** 2
                          + 3.0 * SCALE_LOGIT_ITERS * n8 * (d + 1) ** 2)
     st = res_lr.stats or {}
@@ -189,7 +215,7 @@ def run_scale_leg(timings, flops, metrics):
     metrics["scale_d"] = float(d)
 
 
-def run_electives(ratings_df, train, timings, flops):
+def run_electives(ratings_df, train, timings, flops, eng=None):
     """MLE 01 (block-parallel ALS on MovieLens-1M scale) and MLE 02
     (fused-Lloyd KMeans) — the electives' flagship distributed fits
     (`MLE 01:159-201` "CV takes a few minutes, refit ~1 minute";
@@ -210,6 +236,8 @@ def run_electives(ratings_df, train, timings, flops):
     rmse_als = RegressionEvaluator(labelCol="rating").evaluate(
         als_model.transform(als_test))
     timings["mle01_als"] = time.perf_counter() - t0
+    if eng is not None:
+        eng.mark("mle01_als")
     n_tr = als_train.count()  # the fit's actual nnz (80% split)
     flops["mle01_als"] = 2.0 * als_iters * (n_tr * rank * rank
                                             + (6040 + 3700) * rank ** 3)
@@ -229,6 +257,8 @@ def run_electives(ratings_df, train, timings, flops):
     km_model = KMeans(k=k, maxIter=km_iters, seed=221).fit(km_feats)
     centers = km_model.clusterCenters()
     timings["mle02_kmeans"] = time.perf_counter() - t0
+    if eng is not None:
+        eng.mark("mle02_kmeans")
     n_train = train.count()
     flops["mle02_kmeans"] = 3.0 * km_iters * n_train * len(NUM_COLS) * k
     return {"rmse_als": rmse_als, "kmeans_k": float(len(centers))}
@@ -248,6 +278,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
 
     timings = {}
     flops = {}
+    eng = EngineCounterTrack()
     train, test = df.randomSplit([0.8, 0.2], seed=42)
     train.cache()
     test.cache()
@@ -270,6 +301,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
     ]).fit(train)
     rmse_lr = ev.evaluate(lr_model.transform(test))
     timings["ml02_lr"] = time.perf_counter() - t0
+    eng.mark("ml02_lr")
     d_lr = lr_model.stages[-1].coefficients.toArray().shape[0] + 1
     flops["ml02_lr"] = 2.0 * n_train * d_lr * d_lr  # Gram pass X^T X
 
@@ -281,6 +313,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
                                               maxBins=40)]).fit(train)
     rmse_dt = ev.evaluate(dt_model.transform(test))
     timings["ml06_dt"] = time.perf_counter() - t0
+    eng.mark("ml06_dt")
     flops["ml06_dt"] = 2.0 * 1 * 5 * n_train * 10 * 40
 
     t0 = time.perf_counter()
@@ -290,6 +323,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
                                               seed=42)]).fit(train)
     rmse_rf = ev.evaluate(rf_model.transform(test))
     timings["ml07_rf"] = time.perf_counter() - t0
+    eng.mark("ml07_rf")
     # histogram builds: trees x levels x (rows x features x bins) one-hot
     # accumulations (ops, not dense MXU flops — reported for scale)
     flops["ml07_rf"] = 2.0 * 20 * 6 * n_train * 10 * 40
@@ -308,6 +342,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
                         numFolds=3, parallelism=4, seed=42)
     cv_model = cv.fit(feat_train)
     timings["ml07_cv"] = time.perf_counter() - t0
+    eng.mark("ml07_cv")
     cv_best = float(min(cv_model.avgMetrics))
     # 12 fold fits (3 folds x 2/3 of train each = 2n per param map) + one
     # full-train refit of the winner (approximated by the grid mean)
@@ -332,6 +367,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
     fmin(objective, space, algo=tpe, max_evals=4, trials=Trials(),
          rstate=np.random.RandomState(42))
     timings["ml08_hyperopt"] = time.perf_counter() - t0
+    eng.mark("ml08_hyperopt")
     # 4 evals at the space's mean budget (maxDepth~5, numTrees~15)
     flops["ml08_hyperopt"] = 4 * 2.0 * 15 * 5 * n_train * 10 * 40
 
@@ -348,6 +384,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
         "prediction", F.exp(F.col("prediction")))
     rmse_xgb = ev.evaluate(pred)
     timings["ml11_xgb"] = time.perf_counter() - t0
+    eng.mark("ml11_xgb")
     flops["ml11_xgb"] = 2.0 * 40 * 6 * n_train * 10 * 64
 
     # ---- ML 12: batch inference through the device scorer ---------------
@@ -366,6 +403,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
 
     n_scored = test.mapInPandas(predict_batches, "prediction double").count()
     timings["ml12_mapinpandas"] = time.perf_counter() - t0
+    eng.mark("ml12_mapinpandas")
     _CONF.set("spark.sql.execution.arrow.maxRecordsPerBatch", _old_bs)
     flops["ml12_mapinpandas"] = 2.0 * n_scored * d_lr
 
@@ -388,6 +426,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
     n_groups = train.groupby("room_type").applyInPandas(
         train_group, "room_type string, n bigint, mse double").count()
     timings["ml13_applyinpandas"] = time.perf_counter() - t0
+    eng.mark("ml13_applyinpandas")
     # per-group sklearn LR payload (host math by course design, `ML 13`)
     flops["ml13_applyinpandas"] = 2.0 * n_train * 2 * 2
 
@@ -395,10 +434,10 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
                "rmse_xgb": rmse_xgb, "cv_best_rmse": cv_best,
                "rows_scored": n_scored, "groups": n_groups}
     if ratings_df is not None:
-        metrics.update(run_electives(ratings_df, train, timings, flops))
+        metrics.update(run_electives(ratings_df, train, timings, flops, eng))
     if with_scale:
-        run_scale_leg(timings, flops, metrics)
-    return timings, metrics, flops
+        run_scale_leg(timings, flops, metrics, eng)
+    return timings, metrics, flops, eng.legs
 
 
 def _host_als(ratings, rank, iters, reg, seed=42):
@@ -738,7 +777,7 @@ def pin_goldens():
     df.cache()
     ratings_df, _ = build_ratings(N_RATINGS)
     ratings_df.cache()
-    _, metrics, _ = run_suite(df, N_ROWS, ratings_df, with_scale=False)
+    _, metrics, _, _ = run_suite(df, N_ROWS, ratings_df, with_scale=False)
     with open(GOLDEN_FILE) as f:
         golden = json.load(f)
     golden["bench_metrics_1m"] = {
@@ -802,10 +841,11 @@ def main():
         PROFILER.reset()
         p_before = probe()
         t0 = time.perf_counter()
-        timings, metrics, flops = run_suite(df, N_ROWS, ratings_df)
+        timings, metrics, flops, eng_legs = run_suite(df, N_ROWS, ratings_df)
         wall = time.perf_counter() - t0
         passes.append({"wall": wall, "timings": timings, "metrics": metrics,
-                       "flops": flops, "probe_before": p_before,
+                       "flops": flops, "engine_counters": eng_legs,
+                       "probe_before": p_before,
                        "probe_after": probe(),
                        "profiler": PROFILER.report()})
     pass_walls = [round(p["wall"], 3) for p in passes]
@@ -863,7 +903,11 @@ def main():
                                  if k in fresh else "cached"),
                "host_seconds_per_pass": ([round(p[k], 3) for p in host_passes
                                           if k in p] if k in fresh else None),
-               "speedup_vs_host": round(hb / v, 2) if hb else None}
+               "speedup_vs_host": round(hb / v, 2) if hb else None,
+               # engine-counter deltas for this leg from the BEST pass
+               # (one coherent pass snapshot, not a per-leg mix): cache
+               # hits/misses, h2d/d2h bytes, shuffle volume, compiles
+               "engine_counters": best_pass["engine_counters"].get(k, {})}
         if k in flops:
             leg["device_flops_est"] = flops[k]
             # histogram legs count scatter-accumulation OPS (XLA rewrites

@@ -102,6 +102,26 @@ _register("sml.split.sortMemoBytes", 1 << 30, int,
           "sibling caches so one bench-scale frame's partitions fit — a "
           "budget below one split's working set makes every later weight "
           "cell re-sort (FIFO evicts the in-flight split's own entries)")
+_register("sml.obs.enabled", False, _to_bool,
+          "Flight-recorder event bus (sml_tpu.obs): record typed engine "
+          "events (spans, counters, dispatch decisions, cache traffic, "
+          "collectives, compiles, HBM ledger gauges) into a bounded ring "
+          "buffer for Chrome-trace export, the dispatch audit, and run "
+          "autologging. Disabled, every instrumentation site costs one "
+          "attribute load")
+_register("sml.obs.ringEvents", 65536, int,
+          "Capacity of the flight recorder's in-memory event ring; the "
+          "oldest events are dropped (and counted) once full. Resizing "
+          "preserves the newest events")
+_register("sml.obs.sinkPath", "", str,
+          "Optional JSONL sink: every recorded event is also appended to "
+          "this file as one JSON object per line (empty = ring only). "
+          "Applied immediately when set")
+_register("sml.obs.autoLogRunMetrics", True, _to_bool,
+          "With the recorder enabled, every outermost Estimator.fit under "
+          "an active tracking run logs engine.* metrics (h2d/d2h bytes, "
+          "cache hit rates, route mix, compile count, peak HBM ledger "
+          "bytes) to the run — the MLflow system-metrics equivalent")
 _register("sml.cv.batchFolds", False, _to_bool,
           "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
           "map into one vmapped device program for tree regressors. "

@@ -867,6 +867,11 @@ def _hash_repartition(pdf: pd.DataFrame, keys: List[str], num: int) -> Partition
     sizes = np.array([len(p) for p in parts], dtype=float)
     if sizes.sum() > 0:
         PROFILER.count("shuffle.rows", float(sizes.sum()))
+        # shallow estimate (object columns count pointer width): the
+        # relative shuffle-volume signal MLE 05 reads off the Spark UI,
+        # cheap enough to take on every shuffle
+        PROFILER.count("shuffle.bytes",
+                       float(pdf.memory_usage(index=False).sum()))
         with PROFILER.span("shuffle.partition", rows=int(sizes.sum()),
                            skew=float(sizes.max() / max(sizes.mean(), 1.0))):
             pass

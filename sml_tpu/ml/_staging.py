@@ -187,6 +187,9 @@ def _memo_key(a: np.ndarray) -> tuple:
 
 
 def _cache_put(key, value):
+    from ..obs import LEDGER
+    from ..utils.profiler import PROFILER
+    evicted = 0
     with _stage_lock:
         if key in _stage_cache:
             return
@@ -199,6 +202,15 @@ def _cache_put(key, value):
             old, old_cost = _stage_cache_order.pop(0)
             _stage_cache.pop(old, None)
             _stage_cache_bytes[0] -= old_cost
+            evicted += old_cost
+    LEDGER.alloc("stage_cache", cost)
+    if evicted:
+        from ..obs import RECORDER
+        LEDGER.free("stage_cache", evicted)
+        PROFILER.count("staging.evict_bytes", float(evicted))
+        if RECORDER.enabled:
+            RECORDER.emit("cache", "cache.evict",
+                          args={"pool": "stage_cache", "bytes": evicted})
 
 
 # QUANTIZED BIN-INDEX CACHE (the shared-histogram engine's hot operand):
@@ -240,14 +252,27 @@ def stage_bins_cached(binned: np.ndarray) -> jax.Array:
         return hit
     padded = meshlib.pad_rows(a, meshlib.bucket_rows(a.shape[0], n_dev))[0]
     hit = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    from ..obs import LEDGER, RECORDER
+    stored = evicted = 0
     with _stage_lock:
         if key not in _bin_stage_cache:
             _bin_stage_cache[key] = hit
             _bin_stage_bytes[0] += hit.nbytes
+            stored = hit.nbytes
             budget = _bin_cache_budget()
             while _bin_stage_bytes[0] > budget and len(_bin_stage_cache) > 1:
                 old = next(iter(_bin_stage_cache))
-                _bin_stage_bytes[0] -= _bin_stage_cache.pop(old).nbytes
+                old_bytes = _bin_stage_cache.pop(old).nbytes
+                _bin_stage_bytes[0] -= old_bytes
+                evicted += old_bytes
+    if stored:
+        LEDGER.alloc("bin_cache", stored)
+    if evicted:
+        LEDGER.free("bin_cache", evicted)
+        PROFILER.count("staging.bin_evict_bytes", float(evicted))
+        if RECORDER.enabled:
+            RECORDER.emit("cache", "cache.evict",
+                          args={"pool": "bin_cache", "bytes": evicted})
     PROFILER.count("staging.bin_cache_miss")
     PROFILER.count("staging.h2d_bytes", padded.nbytes)
     return hit
@@ -340,12 +365,16 @@ def _route_mesh(hint, arrays, may_promote: bool = True,
     from ..conf import GLOBAL_CONF
     pre = dispatch.preroute(hint)
     if pre is not None:  # no tunnel / forced mode: skip the probe entirely
+        dispatch.audit_preroute(hint, pre)  # flight-recorder receipt
         return (meshlib.get_mesh() if pre == "device"
                 else dispatch.host_mesh()), pre
     resident = dispatch.WorkHint(hint.flops, hint.kind, hint.out_bytes, None)
-    if dispatch.decide(resident)[0] == "host":
+    if dispatch.decide(resident, _record=False)[0] == "host":
         # the device loses even with everything resident: no point hashing
-        # the arrays to price their H2D (hot on per-batch predict paths)
+        # the arrays to price their H2D (hot on per-batch predict paths).
+        # The probe was unrecorded (_record=False); THIS is the dispatch
+        # decision, so it gets exactly one audit row
+        dispatch.audit_decision(resident, "host")
         return dispatch.host_mesh(), "host"
     dev_mesh = meshlib.get_mesh()
     n_dev = dev_mesh.shape[meshlib.DATA_AXIS]
@@ -492,6 +521,8 @@ def cached_data_parallel(fn: Callable, *, out_replicated: bool = True,
     mesh = meshlib.get_mesh()
     key = (fn, id(mesh), out_replicated, replicated_argnums)
     if key not in _compiled_cache:
+        from ..obs import note_compile
+        note_compile(getattr(fn, "__name__", "fn"))
         _compiled_cache[key] = data_parallel(
             fn, out_replicated=out_replicated,
             replicated_argnums=replicated_argnums)

@@ -132,7 +132,12 @@ class Estimator(Params, Saveable):
     def fit(self, df, params: Optional[dict] = None):
         if params:
             return self.copy(params).fit(df)
-        return self._fit(df)
+        # flight-recorder run autologging: with the recorder on and a
+        # tracking run active, the OUTERMOST fit logs engine.* metric
+        # deltas to the run (obs.autolog_fit is a cheap no-op otherwise)
+        from ..obs import autolog_fit
+        with autolog_fit(self):
+            return self._fit(df)
 
     def _fit(self, df):
         raise NotImplementedError

@@ -55,7 +55,10 @@ def _stats_route(hint: WorkHint) -> str:
     CV folds/tuning trials present a new length every call, and each first
     sight cost a ~150ms XLA:CPU compile inside the r4 bench's timed pass."""
     pre = dispatch.preroute(hint)
-    return pre if pre is not None else dispatch.decide(hint)[0]
+    if pre is not None:
+        dispatch.audit_preroute(hint, pre)  # flight-recorder receipt
+        return pre
+    return dispatch.decide(hint)[0]
 
 
 def host_reg_stats(pred: np.ndarray, lab: np.ndarray):

@@ -565,6 +565,8 @@ def _compiled_chunk(es: EnsembleSpec, chunk: int):
         and GLOBAL_CONF.getBool("sml.tpu.donate") else ()
     key = (es, chunk, id(mesh), _hist_subtract(), donate)
     if key not in _chunk_cache:
+        from ..obs import note_compile
+        note_compile(f"tree_chunk_{chunk}")
         program = _make_chunk_program(es, chunk)
         P = jax.sharding.PartitionSpec
         Dx = _meshlib.DATA_AXIS
@@ -591,16 +593,24 @@ def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
     margin = jax.device_put(
         np.full((binned_dev.shape[0],), base, np.float32),
         _meshlib.data_sharding(mesh, 1))
-    rng = jax.random.key_data(jax.random.PRNGKey(seed))
-    packs_parts = []
-    t0 = 0
-    while t0 < es.n_trees:
-        c = min(chunk, es.n_trees - t0)
-        margin, packs = _compiled_chunk(es, c)(
-            binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
-        packs_parts.append(packs)
-        t0 += c
-    packs = np.concatenate(jax.device_get(packs_parts), axis=0)
+    # the chain's one fresh HBM buffer: donated between chunks, so live
+    # bytes stay ONE margin's worth for the whole chunked fit
+    from ..obs import LEDGER
+    margin_bytes = margin.nbytes
+    LEDGER.alloc("boost_margin", margin_bytes)
+    try:
+        rng = jax.random.key_data(jax.random.PRNGKey(seed))
+        packs_parts = []
+        t0 = 0
+        while t0 < es.n_trees:
+            c = min(chunk, es.n_trees - t0)
+            margin, packs = _compiled_chunk(es, c)(
+                binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
+            packs_parts.append(packs)
+            t0 += c
+        packs = np.concatenate(jax.device_get(packs_parts), axis=0)
+    finally:
+        LEDGER.free("boost_margin", margin_bytes)
     return _unpack_trees(packs), base
 
 
@@ -633,6 +643,8 @@ def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                                      seed, rounds)
     key = (es, id(_meshlib.get_mesh()), _hist_subtract())
     if key not in _ensemble_cache:
+        from ..obs import note_compile
+        note_compile("tree_ensemble")
         _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
                                              replicated_argnums=(3,))
     compiled = _ensemble_cache[key]
@@ -729,6 +741,8 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
 
     key = (es, fo, id(mesh), _hist_subtract())
     if key not in _folds_cache:
+        from ..obs import note_compile
+        note_compile(f"tree_ensemble_folds_{fo}")
         program = _make_ensemble_program(es)
 
         def batched(binned_f, y_f, mask_f, rng):
@@ -778,6 +792,8 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
     from ..parallel import mesh as _meshlib
     key = (spec, id(_meshlib.get_mesh()), _hist_subtract())
     if key not in _tree_cache:
+        from ..obs import note_compile
+        note_compile("tree_single")
         _tree_cache[key] = data_parallel(
             _build_tree_program(spec, _hist_dtype()), replicated_argnums=(4,))
     compiled = _tree_cache[key]

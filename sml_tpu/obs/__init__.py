@@ -1,0 +1,132 @@
+"""sml_tpu.obs — the engine's flight recorder.
+
+The reference debugs through the Spark UI / Ganglia (shuffle volumes,
+storage, executor timelines — `SML/ML Electives/MLE 05 - Best
+Practices.py:24-36`); this package is that surface for the mesh engine,
+built on ONE structured event bus:
+
+- `RECORDER` (`_recorder`): typed events — spans, counters, dispatch
+  decisions, cache traffic, collective launches, program compiles, HBM
+  gauges — in a bounded ring with an optional JSONL sink
+  (`sml.obs.sinkPath`). Enabled by `sml.obs.enabled`; disabled it costs
+  one attribute load per instrumentation site.
+- `export_chrome_trace(path)` (`_trace`): the ring as a Chrome/Perfetto
+  trace — host thread tracks, a virtual device track for dispatched
+  programs, counter tracks for H2D/D2H bytes and cache/HBM occupancy.
+- `audit_report()` (`_audit`): every `dispatch.decide` with its predicted
+  host/device times and the routed program's measured wall — calibration
+  drift and would-have-been-faster misroutes.
+- `memory_report()` / `LEDGER` (`_ledger`): live/peak device bytes across
+  the bin cache, staging cache, and donated boosting carries.
+- `engine_metrics()` + fit autologging: outermost `Estimator.fit` under an
+  active tracking run logs `engine.*` metrics (the MLflow system-metrics
+  mirror), gated by `sml.obs.autoLogRunMetrics`.
+
+See docs/OBSERVABILITY.md for the event model and worked examples.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+from ..conf import GLOBAL_CONF
+from . import _audit, _ledger
+from ._audit import records as audit_records, report as audit_report
+from ._ledger import LEDGER, report as memory_report
+from ._recorder import RECORDER, Event
+from ._trace import export_chrome_trace
+
+__all__ = ["RECORDER", "Event", "LEDGER", "export_chrome_trace",
+           "audit_report", "audit_records", "memory_report",
+           "engine_metrics", "reset", "enabled", "note_compile",
+           "autolog_fit"]
+
+
+def enabled() -> bool:
+    return RECORDER.enabled
+
+
+def reset() -> None:
+    """Drop recorded events, audit records, and re-arm HBM peaks (live
+    ledger bytes persist — they describe real cache residency)."""
+    RECORDER.reset()
+    _audit.reset()
+    LEDGER.reset_peaks()
+
+
+def note_compile(name: str) -> None:
+    """Mark a program-cache miss (= a fresh trace + XLA compile/replay):
+    bumps the `compile.programs` counter and records a compile event."""
+    from ..utils.profiler import PROFILER
+    PROFILER.count("compile.programs")
+    if RECORDER.enabled:
+        RECORDER.emit("compile", "compile.trace", args={"program": name})
+
+
+# ------------------------------------------------------------ engine metrics
+def engine_metrics() -> Dict[str, float]:
+    """The engine's health snapshot as flat `engine.*` metrics — byte
+    volumes, cache hit rates, route mix, compile count, peak HBM bytes.
+    Sourced from the recorder's own totals (independent of
+    `sml.profiler.enabled`), the dispatch audit, and the memory ledger."""
+    t = RECORDER.counters()
+    hits = t.get("staging.cache_hit", 0.0)
+    misses = t.get("staging.cache_miss", 0.0)
+    bhits = t.get("staging.bin_cache_hit", 0.0)
+    bmisses = t.get("staging.bin_cache_miss", 0.0)
+    return {
+        "engine.h2d_bytes": t.get("staging.h2d_bytes", 0.0),
+        "engine.d2h_bytes": t.get("staging.d2h_bytes", 0.0),
+        "engine.h2d_bytes_saved": t.get("staging.h2d_bytes_saved", 0.0),
+        "engine.cache_hit_rate": hits / max(hits + misses, 1.0),
+        "engine.bin_cache_hit_rate": bhits / max(bhits + bmisses, 1.0),
+        "engine.route_device": t.get("dispatch.route_device", 0.0),
+        "engine.route_host": t.get("dispatch.route_host", 0.0),
+        "engine.compile_programs": t.get("compile.programs", 0.0),
+        "engine.hbm_peak_bytes": float(LEDGER.peak_total()),
+        "engine.shuffle_rows": t.get("shuffle.rows", 0.0),
+    }
+
+
+_fit_depth = threading.local()
+
+
+@contextlib.contextmanager
+def autolog_fit(estimator):
+    """Wrap one Estimator.fit: with the recorder on, autologging enabled
+    (`sml.obs.autoLogRunMetrics`) and a tracking run active on this
+    thread, log the fit's `engine.*` metric DELTAS to the run — the
+    MLflow system-metrics mirror. Only the OUTERMOST fit on a thread logs
+    (a Pipeline's stage fits and a CrossValidator's inner fits fold into
+    their parent, exactly like nested autologged models)."""
+    if not RECORDER.enabled:
+        yield
+        return
+    depth = getattr(_fit_depth, "d", 0)
+    _fit_depth.d = depth + 1
+    before: Optional[Dict[str, float]] = None
+    run = None
+    try:
+        if depth == 0 and GLOBAL_CONF.getBool("sml.obs.autoLogRunMetrics"):
+            from .. import tracking
+            run = tracking.active_run()
+            if run is not None:
+                before = engine_metrics()
+        yield
+    finally:
+        _fit_depth.d = depth
+        if run is not None and before is not None:
+            after = engine_metrics()
+            delta = {}
+            for k, v in after.items():
+                if k.endswith(("_rate", "_peak_bytes")):
+                    delta[k] = v          # level metrics: log the level
+                else:
+                    delta[k] = v - before.get(k, 0.0)
+            try:
+                from .. import tracking
+                tracking.log_engine_metrics(delta)
+            except Exception:
+                pass  # autologging must never fail a fit

@@ -1,0 +1,176 @@
+"""The flight recorder's event bus: a bounded ring of typed events.
+
+Every instrumentation site in the engine (profiler spans/counters, dispatch
+decisions, cache traffic, collective launches, program compiles, HBM ledger
+gauges) funnels through ONE recorder so the Chrome-trace exporter, the
+dispatch audit, and run autologging all read the same record. The Spark-UI
+analogue: the event-log JSON the UI and history server are rendered from.
+
+Hot-path contract (asserted in tests/test_obs.py): with the recorder
+disabled every emit site early-outs on a single attribute load
+(`RECORDER.enabled` is a plain bool, kept current by conf on_set hooks) —
+no lock, no allocation, no conf lookup.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..conf import GLOBAL_CONF
+
+
+@dataclass
+class Event:
+    """One typed engine event.
+
+    kind: "span" | "counter" | "dispatch" | "cache" | "collective" |
+          "compile". Counter events carry the post-increment cumulative
+          total (gauges carry the current value) in args["total"], so the
+          trace exporter can render counter tracks without replaying.
+    ts:   seconds since the recorder epoch (reset() re-zeros it).
+    dur:  seconds, spans only.
+    tid:  small dense per-thread id (stable within a recorder lifetime).
+    """
+    ts: float
+    kind: str
+    name: str
+    dur: Optional[float] = None
+    tid: int = 0
+    args: Dict[str, object] = field(default_factory=dict)
+
+
+class Recorder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque(
+            maxlen=max(int(GLOBAL_CONF.getInt("sml.obs.ringEvents")), 16))
+        self._totals: Dict[str, float] = {}
+        self._tids: Dict[int, int] = {}
+        self._epoch = time.perf_counter()
+        self._sink = None
+        self._sink_path: Optional[str] = None
+        self.dropped = 0
+        # plain attribute, NOT a property: the disabled-path cost per event
+        self.enabled: bool = GLOBAL_CONF.getBool("sml.obs.enabled")
+
+    # ------------------------------------------------------------- config
+    def reconfigure(self) -> None:
+        """Re-read the sml.obs.* conf (fired by on_set hooks)."""
+        with self._lock:
+            size = max(int(GLOBAL_CONF.getInt("sml.obs.ringEvents")), 16)
+            if size != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=size)
+            path = str(GLOBAL_CONF.get("sml.obs.sinkPath") or "").strip()
+            if path != (self._sink_path or ""):
+                if self._sink is not None:
+                    try:
+                        self._sink.close()
+                    except OSError:
+                        pass
+                self._sink = None
+                self._sink_path = path or None
+        self.enabled = GLOBAL_CONF.getBool("sml.obs.enabled")
+
+    # --------------------------------------------------------------- emit
+    def emit(self, kind: str, name: str, dur: Optional[float] = None,
+             ts: Optional[float] = None,
+             args: Optional[Dict[str, object]] = None) -> None:
+        """Record one event. `ts` is an absolute perf_counter stamp (span
+        starts); None stamps now. Cheap no-op when disabled."""
+        if not self.enabled:
+            return
+        at = (ts if ts is not None else time.perf_counter()) - self._epoch
+        ident = threading.get_ident()
+        with self._lock:
+            # tid assignment under the lock: two threads' first emits must
+            # not share a lane (len() is not a unique id outside it)
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
+            ev = Event(ts=max(at, 0.0), kind=kind, name=name, dur=dur,
+                       tid=tid, args=args or {})
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+            sink = self._ensure_sink()
+            if sink is not None:  # under the lock: lines must not interleave
+                self._write_sink(ev, sink)
+
+    def counter(self, name: str, inc: float = 1.0) -> None:
+        """Cumulative counter: bumps the running total and records a
+        counter event carrying the new total."""
+        if not self.enabled:
+            return
+        with self._lock:
+            total = self._totals.get(name, 0.0) + inc
+            self._totals[name] = total
+        self.emit("counter", name, args={"total": total, "inc": inc})
+
+    def gauge(self, name: str, value: float) -> None:
+        """Point-in-time gauge (HBM ledger live bytes): the recorded
+        total IS the current value, not a sum."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._totals[name] = float(value)
+        self.emit("counter", name, args={"total": float(value),
+                                         "gauge": True})
+
+    def span(self, name: str, t0: float, dur: float, **meta) -> None:
+        """A completed span: `t0` is its absolute perf_counter start."""
+        if not self.enabled:
+            return
+        self.emit("span", name, dur=dur, ts=t0,
+                  args={k: v for k, v in meta.items() if v is not None})
+
+    # --------------------------------------------------------------- sink
+    def _ensure_sink(self):
+        if self._sink is None and self._sink_path:
+            try:
+                self._sink = open(self._sink_path, "a")
+            except OSError:
+                self._sink_path = None
+        return self._sink
+
+    def _write_sink(self, ev: Event, sink) -> None:
+        try:
+            rec = {"ts": round(ev.ts, 6), "kind": ev.kind, "name": ev.name,
+                   "tid": ev.tid}
+            if ev.dur is not None:
+                rec["dur"] = round(ev.dur, 6)
+            if ev.args:
+                rec["args"] = ev.args
+            sink.write(json.dumps(rec, default=str) + "\n")
+            sink.flush()
+        except (OSError, ValueError):
+            self._sink_path = None  # a dead sink must not take fits down
+            self._sink = None
+
+    # ------------------------------------------------------------ reading
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._ring)
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._totals)
+
+    def reset(self) -> None:
+        """Drop all events/totals and re-zero the epoch (enabled state and
+        sink configuration survive)."""
+        with self._lock:
+            self._ring.clear()
+            self._totals.clear()
+            self.dropped = 0
+            self._epoch = time.perf_counter()
+
+
+RECORDER = Recorder()
+
+for _key in ("sml.obs.enabled", "sml.obs.ringEvents", "sml.obs.sinkPath"):
+    GLOBAL_CONF.on_set(_key, RECORDER.reconfigure)

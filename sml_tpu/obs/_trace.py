@@ -1,0 +1,88 @@
+"""Chrome/Perfetto `trace.json` exporter over the flight-recorder ring.
+
+Track layout (the Spark-UI executor-timeline equivalent):
+
+- pid 1 "sml_tpu host": one lane per recording host thread; every span
+  event renders as a complete ("X") event, so nested engine spans stack
+  exactly as the profiler measured them.
+- pid 2 "device (dispatched programs)": the virtual device track —
+  `program.*` spans whose dispatch route was "device" are drawn here (one
+  lane per dispatching thread, so concurrent tuning trials stay legible).
+  Wall time on this track includes the host-side dispatch+readback wait:
+  that IS the cost the dispatcher prices, and the honest number for a
+  tunneled chip.
+- counter tracks ("C" events, pid 1): every byte-volume counter
+  (`*_bytes*`) and HBM ledger gauge (`hbm.*`) renders its cumulative
+  total / live value at each change — H2D/D2H traffic and cache
+  occupancy over time.
+
+Load the file at chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ._recorder import RECORDER, Event
+
+PID_HOST = 1
+PID_DEVICE = 2
+
+
+def _is_counter_track(name: str) -> bool:
+    return ("_bytes" in name or name.endswith(".bytes")
+            or name.startswith("hbm."))
+
+
+def _is_device_span(ev: Event) -> bool:
+    return ev.name.startswith("program.") \
+        and ev.args.get("route") == "device"
+
+
+def to_trace_events(events: List[Event]) -> List[dict]:
+    out: List[dict] = [
+        {"ph": "M", "pid": PID_HOST, "tid": 0, "name": "process_name",
+         "args": {"name": "sml_tpu host"}},
+        {"ph": "M", "pid": PID_DEVICE, "tid": 0, "name": "process_name",
+         "args": {"name": "device (dispatched programs)"}},
+    ]
+    seen_tids = set()
+    for ev in events:
+        ts_us = ev.ts * 1e6
+        if ev.kind == "span":
+            pid = PID_DEVICE if _is_device_span(ev) else PID_HOST
+            key = (pid, ev.tid)
+            if key not in seen_tids:
+                seen_tids.add(key)
+                label = ("dispatch-thread" if pid == PID_DEVICE
+                         else "host-thread")
+                out.append({"ph": "M", "pid": pid, "tid": ev.tid,
+                            "name": "thread_name",
+                            "args": {"name": f"{label}-{ev.tid}"}})
+            out.append({"ph": "X", "pid": pid, "tid": ev.tid,
+                        "ts": ts_us, "dur": max((ev.dur or 0.0), 0.0) * 1e6,
+                        "name": ev.name, "cat": ev.kind,
+                        "args": dict(ev.args)})
+        elif ev.kind == "counter" and _is_counter_track(ev.name):
+            out.append({"ph": "C", "pid": PID_HOST, "tid": 0, "ts": ts_us,
+                        "name": ev.name, "cat": "counter",
+                        "args": {"value": ev.args.get("total", 0.0)}})
+        elif ev.kind in ("dispatch", "cache", "collective", "compile"):
+            # instant markers: visible pins on the timeline without lanes
+            out.append({"ph": "i", "s": "t", "pid": PID_HOST,
+                        "tid": ev.tid, "ts": ts_us, "name": ev.name,
+                        "cat": ev.kind, "args": dict(ev.args)})
+    return out
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the recorder's current ring as a Chrome trace; returns the
+    path (so callers can log it as a tracking artifact)."""
+    doc = {"traceEvents": to_trace_events(RECORDER.events()),
+           "displayTimeUnit": "ms",
+           "otherData": {"producer": "sml_tpu.obs",
+                         "dropped_events": RECORDER.dropped}}
+    with open(path, "w") as f:
+        json.dump(doc, f, default=str)
+    return path

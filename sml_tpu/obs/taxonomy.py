@@ -1,0 +1,84 @@
+"""Registered dotted-name taxonomy for spans, counters, and events.
+
+The profiler report, the Chrome-trace exporter, the engine-metrics
+autologger, and the bench's per-leg counter snapshots all key off these
+names; a call site inventing `staging.h2dBytes` next to
+`staging.h2d_bytes` silently splits a metric in two. Every
+`PROFILER.span`/`PROFILER.count` and `RECORDER.emit/counter/gauge` call
+site is AST-linted against this registry (`scripts/check_obs_taxonomy.py`,
+enforced by tests/test_obs_taxonomy.py).
+
+Entries are exact names or `prefix.*` wildcards (wildcards cover the
+f-string sites whose suffix is runtime data: the op behind a
+`materialize.<op>` span, the fn behind `program.<name>`).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+SPANS = {
+    # frame engine
+    "materialize.*",
+    "shuffle.partition", "shuffle.dropDuplicates", "shuffle.join",
+    "shuffle.sort", "shuffle.repartition",
+    # ML engine
+    "fused_transform", "binning.predict",
+    "program.*",          # program.<fn> / program.tree_ensemble / ...
+}
+
+COUNTERS = {
+    "staging.cache_hit", "staging.cache_miss",
+    "staging.bin_cache_hit", "staging.bin_cache_miss",
+    "staging.h2d_bytes", "staging.d2h_bytes", "staging.h2d_bytes_saved",
+    "staging.evict_bytes", "staging.bin_evict_bytes",
+    "shuffle.rows", "shuffle.bytes",
+    "cv.batchFolds.fallback",
+    "compile.programs",
+    "dispatch.route_*",   # dispatch.route_host / dispatch.route_device
+    "collective.*",       # per-trace collective launch counts
+}
+
+GAUGES = {
+    "hbm.*",              # hbm.<pool>_bytes / hbm.total_bytes
+}
+
+EVENTS = {
+    "dispatch.*",         # dispatch.host / dispatch.device
+    "cache.*",            # cache.evict / ...
+    "collective.*",       # collective.psum / ...
+    "compile.*",          # compile.trace / compile.cache_dir
+}
+
+_BY_KIND = {"span": SPANS, "count": COUNTERS, "counter": COUNTERS,
+            "gauge": GAUGES, "emit": EVENTS}
+
+
+def _match(name: str, registry: Iterable[str]) -> bool:
+    for entry in registry:
+        if entry.endswith("*"):
+            if name.startswith(entry[:-1]):
+                return True
+        elif name == entry:
+            return True
+    return False
+
+
+def is_registered(kind: str, name: str) -> bool:
+    """Exact-name check (`kind` is the call-site method: span / count /
+    counter / gauge / emit)."""
+    reg = _BY_KIND.get(kind)
+    return reg is not None and _match(name, reg)
+
+
+def prefix_registered(kind: str, prefix: str) -> bool:
+    """f-string check: the literal prefix before the first interpolation
+    must sit under some wildcard entry (a dynamic suffix can only be
+    legal when the family itself is registered)."""
+    reg = _BY_KIND.get(kind)
+    if reg is None:
+        return False
+    for entry in reg:
+        if entry.endswith("*") and prefix.startswith(entry[:-1]):
+            return True
+    return False

@@ -21,11 +21,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs._recorder import RECORDER as _OBS
 from .mesh import DATA_AXIS
+
+
+def _note(op: str) -> None:
+    """Flight-recorder collective event. These wrappers execute at TRACE
+    time (the collective itself runs inside the compiled program), so one
+    event marks one collective launch PER COMPILED PROGRAM — the static
+    count a graph runtime can know without a device profiler; multiply by
+    program executions for wire traffic. No-op when the recorder is off."""
+    if _OBS.enabled:
+        _OBS.emit("collective", f"collective.{op}")
+        _OBS.counter(f"collective.{op}")
 
 
 def psum(x, axis: str = DATA_AXIS):
     """Allreduce-sum over the mesh axis — the `treeAggregate` replacement."""
+    _note("psum")
     return lax.psum(x, axis_name=axis)
 
 
@@ -40,31 +53,38 @@ def psum_scalars(*xs, axis: str = DATA_AXIS):
 
 
 def pmean(x, axis: str = DATA_AXIS):
+    _note("pmean")
     return lax.pmean(x, axis_name=axis)
 
 
 def pmax(x, axis: str = DATA_AXIS):
+    _note("pmax")
     return lax.pmax(x, axis_name=axis)
 
 
 def pmin(x, axis: str = DATA_AXIS):
+    _note("pmin")
     return lax.pmin(x, axis_name=axis)
 
 
 def all_gather(x, axis: str = DATA_AXIS, *, tiled: bool = False):
+    _note("all_gather")
     return lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = DATA_AXIS, *, scatter_dimension: int = 0):
+    _note("reduce_scatter")
     return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dimension, tiled=True)
 
 
 def all_to_all(x, axis: str = DATA_AXIS, *, split_axis: int = 0, concat_axis: int = 0):
     """Device-side shuffle: exchange row blocks between chips over ICI."""
+    _note("all_to_all")
     return lax.all_to_all(x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
 def ppermute(x, perm, axis: str = DATA_AXIS):
+    _note("ppermute")
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 

@@ -39,6 +39,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..conf import GLOBAL_CONF, _register, _to_bool
+from ..obs import _audit as _obs_audit
+from ..obs._recorder import RECORDER as _OBS
 from . import mesh as meshlib
 
 _register("sml.dispatch.mode", "auto", str,
@@ -107,6 +109,8 @@ def ensure_compile_cache() -> Optional[str]:
     except Exception:
         return None  # older jax without these flags: best-effort
     _compile_cache_state["dir"] = cache
+    if _OBS.enabled:
+        _OBS.emit("compile", "compile.cache_dir", args={"dir": cache})
     return cache
 
 
@@ -339,22 +343,79 @@ def preroute(hint: Optional[WorkHint]) -> Optional[str]:
     return None
 
 
-def decide(hint: Optional[WorkHint]) -> Tuple[str, bool]:
+def _preroute_reason(hint: Optional[WorkHint]) -> str:
+    """Why preroute() short-circuited — recorded by the dispatch audit so
+    a forced decision is never mistaken for a priced one."""
+    if _default_backend() == "cpu":
+        return "no-tunnel"
+    mode = str(GLOBAL_CONF.get("sml.dispatch.mode"))
+    if mode in ("host", "device"):
+        return "forced-mode"
+    if hint is None:
+        return "no-hint"
+    return "local-chip"
+
+
+def audit_preroute(hint: Optional[WorkHint], route: str) -> None:
+    """Record a preroute short-circuit in the dispatch audit (no-op with
+    the flight recorder off, or for unhinted programs — there is nothing
+    to price). Shared by decide() and the preroute fast paths in
+    _staging._route_mesh / evaluation._stats_route.
+
+    Deliberately does NOT run the tunnel calibration: a forced route was
+    never priced, and measuring bandwidths (seconds of probe traffic)
+    just to stamp an audit row would make enabling observability change
+    engine behavior. If calibration hasn't happened yet, the device
+    prediction is the rate-only model and the record is marked
+    uncalibrated so the audit's misroute logic won't trust it."""
+    if not _OBS.enabled or hint is None:
+        return
+    _obs_audit.record(hint, route, host_time(hint),
+                      device_time(hint, CALIBRATION), forced=True,
+                      reason=_preroute_reason(hint),
+                      calibrated=CALIBRATION._done)
+
+
+def audit_decision(hint: Optional[WorkHint], route: str) -> None:
+    """Record a priced, unforced decision a caller made from its own
+    decide(..., _record=False) probes (see _staging._route_mesh's
+    resident-cost fast path) — exactly one audit row per dispatch."""
+    if not _OBS.enabled or hint is None:
+        return
+    cal = CALIBRATION.ensure()
+    _obs_audit.record(hint, route, host_time(hint),
+                      device_time(hint, cal), forced=False)
+
+
+def decide(hint: Optional[WorkHint],
+           _record: bool = True) -> Tuple[str, bool]:
     """(route, promote): route is "host"|"device"; promote is True when the
     device loses ONLY because of the one-time H2D staging cost — i.e. a
     device-resident copy of this dataset would win, so the caller should
-    stage it in the background and let later fits ride the chip."""
+    stage it in the background and let later fits ride the chip.
+
+    `_record=False` suppresses the dispatch-audit row — for callers
+    using decide() as an internal pricing PROBE rather than the decision
+    itself (the audit must count dispatches, not probes)."""
     pre = preroute(hint)
     if pre is not None:
+        if _record:
+            audit_preroute(hint, pre)
         return pre, False
     cal = CALIBRATION.ensure()
     t_host = host_time(hint)
-    if device_time(hint, cal) <= t_host:
+    t_device = device_time(hint, cal)
+    if t_device <= t_host:
+        if _record and _OBS.enabled:
+            _obs_audit.record(hint, "device", t_host, t_device,
+                              forced=False)
         return "device", False
     # Promote only on a DECISIVE resident-device win: flipping a dataset's
     # route costs a fresh trace/compile of every program it touches, so a
     # marginal (<3x) projected gain is not worth the switch.
     resident = WorkHint(hint.flops, hint.kind, hint.out_bytes, None)
+    if _record and _OBS.enabled:
+        _obs_audit.record(hint, "host", t_host, t_device, forced=False)
     return "host", 3.0 * device_time(resident, cal) <= t_host
 
 

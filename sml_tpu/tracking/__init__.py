@@ -147,6 +147,18 @@ def log_metrics(metrics: Dict[str, float], step: Optional[int] = None) -> None:
         log_metric(k, v, step=step)
 
 
+def log_engine_metrics(metrics: Dict[str, float],
+                       step: Optional[int] = None) -> None:
+    """Log flight-recorder engine metrics to the ACTIVE run (no implicit
+    run creation — system metrics must never spawn runs). Keys are
+    namespaced under `engine.` if not already; the MLflow system-metrics
+    mirror, fed by `sml_tpu.obs.autolog_fit` on every outermost fit."""
+    if active_run() is None:
+        return
+    log_metrics({(k if k.startswith("engine.") else f"engine.{k}"):
+                 float(v) for k, v in metrics.items()}, step=step)
+
+
 def set_tag(key: str, value: Any) -> None:
     r = _require_run()
     _store.log_kv(r.info.experiment_id, r.info.run_id, "tags", key, value)
@@ -646,7 +658,8 @@ def install_mlflow_shim() -> None:
 
 
 __all__ = ["start_run", "end_run", "active_run", "log_param", "log_params",
-           "log_metric", "log_metrics", "log_artifact", "log_artifacts",
+           "log_metric", "log_metrics", "log_engine_metrics",
+           "log_artifact", "log_artifacts",
            "log_figure", "log_text", "log_dict", "set_tag", "set_tags",
            "set_experiment", "set_tracking_uri", "get_tracking_uri",
            "get_run", "search_runs", "register_model", "infer_signature",

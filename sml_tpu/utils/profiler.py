@@ -19,6 +19,8 @@ from typing import Dict, Iterator, List, Optional
 import jax
 
 from ..conf import GLOBAL_CONF
+from ..obs import _audit as _obs_audit
+from ..obs._recorder import RECORDER as _OBS
 
 
 @dataclass
@@ -36,11 +38,22 @@ class Profiler:
         self._spans: List[Span] = []
         self._counters: Dict[str, float] = {}
         self._tls = threading.local()
+        # reset() generation: bumped on every reset so spans OPEN across a
+        # reset invalidate instead of attributing child time to a stale
+        # parent entry (and instead of appending a span whose wall time
+        # straddles the reset). Thread-local stacks lazily re-create when
+        # their recorded generation goes stale — reset() cannot reach
+        # other threads' TLS directly.
+        self._gen = 0
 
     def count(self, name: str, inc: float = 1.0) -> None:
         """Engine counters (host↔device bytes, staging-cache hits, ...) —
         the MLE 05-style observability the Spark UI/Ganglia provided
-        (`SML/ML Electives/MLE 05:24-36`)."""
+        (`SML/ML Electives/MLE 05:24-36`). Forwarded to the flight
+        recorder (`sml_tpu.obs`) when it is on, so counter tracks and
+        engine.* run metrics see the same stream."""
+        if _OBS.enabled:
+            _OBS.counter(name, inc)
         if not self.enabled:
             return
         with self._lock:
@@ -58,27 +71,47 @@ class Profiler:
     def span(self, name: str, rows: Optional[int] = None, **meta) -> Iterator[None]:
         """Nested spans subtract from the parent's SELF time, so a
         `materialize` that waits on a device program reports only its own
-        host-side cost — totals in the report stay attributable."""
-        if not self.enabled:
+        host-side cost — totals in the report stay attributable.
+
+        Runs when the profiler OR the flight recorder is on; the recorder
+        additionally gets a timestamped span event (for the Chrome trace)
+        and, for spans carrying a dispatch `route`, feeds the measured
+        wall time back to the dispatch audit."""
+        prof_on = self.enabled
+        obs_on = _OBS.enabled
+        if not prof_on and not obs_on:
             yield
             return
-        stack = getattr(self._tls, "stack", None)
-        if stack is None:
-            stack = self._tls.stack = []
-        child_acc = [0.0]
-        stack.append(child_acc)
+        if prof_on:
+            gen = self._gen
+            tls = self._tls
+            if getattr(tls, "gen", None) != gen:
+                tls.stack = []   # stale stack from before a reset()
+                tls.gen = gen
+            stack = tls.stack
+            child_acc = [0.0]
+            stack.append(child_acc)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            stack.pop()
-            if stack:
-                stack[-1][0] += dt
-            with self._lock:
-                self._spans.append(
-                    Span(name, dt, rows, meta,
-                         self_s=max(0.0, dt - child_acc[0])))
+            if prof_on:
+                if self._gen == gen:
+                    stack.pop()
+                    if stack:
+                        stack[-1][0] += dt
+                    with self._lock:
+                        self._spans.append(
+                            Span(name, dt, rows, meta,
+                                 self_s=max(0.0, dt - child_acc[0])))
+                # else: reset() fired mid-span — this span's timing
+                # straddles it and the stack was invalidated; drop both
+            if obs_on and _OBS.enabled:
+                _OBS.span(name, t0, dt, rows=rows, **meta)
+                route = meta.get("route")
+                if route in ("host", "device"):
+                    _obs_audit.attach(route, name, dt)
 
     def spans(self) -> List[Span]:
         with self._lock:
@@ -88,6 +121,7 @@ class Profiler:
         with self._lock:
             self._spans.clear()
             self._counters.clear()
+            self._gen += 1
 
     def report(self) -> str:
         """Spark-UI-style aggregate table: op, calls, total wall, SELF time
