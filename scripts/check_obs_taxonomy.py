@@ -1,143 +1,41 @@
 #!/usr/bin/env python
-"""Name-taxonomy lint for the flight recorder (PR 2 satellite).
+"""DEPRECATED shim — the obs name-taxonomy lint now lives in
+`sml_tpu/lint/rules/taxonomy.py` as the graftlint rule `obs-taxonomy`.
 
-AST-greps every `PROFILER.span(...)` / `PROFILER.count(...)` and
-`RECORDER.emit/counter/gauge(...)` call site under sml_tpu/ and checks
-the event/span/counter name against the registered dotted-name taxonomy
-(`sml_tpu/obs/taxonomy.py`), so names cannot silently drift between the
-modules that emit them and the report/exporter/autologger that read them.
-
-Rules:
-- a literal string name must be registered (exactly, or under a
-  `prefix.*` wildcard);
-- an f-string name's literal prefix (the part before the first
-  interpolation) must sit under a registered wildcard — dynamic suffixes
-  are only legal for registered families;
-- any other (computed) name argument is a violation OUTSIDE sml_tpu/obs/
-  (the recorder itself forwards names that originated at checked call
-  sites; everyone else must write literals).
-
-Exit status 0 = clean; 1 = violations (printed one per line).
-Enforced by tests/test_obs_taxonomy.py.
+Run `python scripts/graftlint.py` for the full engine-invariant rule
+set; this entry point (and its `check_file` / `check_tree` /
+`_load_taxonomy` / `main` API) is kept verbatim so existing tooling and
+tests/test_obs_taxonomy.py keep working unchanged.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
 import sys
-from typing import List, Tuple
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
+
+
+def _load_rule_module():
+    """The taxonomy rule module via the standalone graftlint loader (no
+    sml_tpu / jax import — same contract as the original script)."""
+    spec = importlib.util.spec_from_file_location(
+        "_graftlint_runner", os.path.join(HERE, "graftlint.py"))
+    runner = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(runner)
+    return runner.load_linter().rules.taxonomy
+
+
+_taxonomy_rule = _load_rule_module()
+
 PKG = os.path.join(REPO, "sml_tpu")
-
-# receiver name -> {method -> (arg index of the NAME, taxonomy kind)}
-TARGETS = {
-    "PROFILER": {"span": (0, "span"), "count": (0, "count")},
-    "RECORDER": {"emit": (1, "emit"), "counter": (0, "counter"),
-                 "gauge": (0, "gauge")},
-    "_OBS": {"emit": (1, "emit"), "counter": (0, "counter"),
-             "gauge": (0, "gauge")},
-}
-
-
-def _receiver_name(node: ast.expr) -> str:
-    """The identifier a method is called on: PROFILER.span → "PROFILER",
-    self._prof.count → "_prof" (unmatched), obs.RECORDER.emit →
-    "RECORDER"."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return ""
-
-
-def _joined_prefix(node: ast.JoinedStr) -> str:
-    """Literal prefix of an f-string up to the first interpolation."""
-    prefix = ""
-    for part in node.values:
-        if isinstance(part, ast.Constant) and isinstance(part.value, str):
-            prefix += part.value
-        else:
-            break
-    return prefix
-
-
-def check_file(path: str, taxonomy) -> List[Tuple[str, int, str]]:
-    rel = os.path.relpath(path, REPO)
-    # the event bus itself (obs/) and its front-end (utils/profiler.py)
-    # forward names that were linted at their ORIGINATING call sites
-    in_obs = (os.sep + "obs" + os.sep in path
-              or path.endswith(os.path.join("utils", "profiler.py")))
-    try:
-        tree = ast.parse(open(path).read(), filename=path)
-    except SyntaxError as e:
-        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
-    out: List[Tuple[str, int, str]] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)):
-            continue
-        methods = TARGETS.get(_receiver_name(node.func.value))
-        if methods is None or node.func.attr not in methods:
-            continue
-        arg_idx, kind = methods[node.func.attr]
-        if len(node.args) <= arg_idx:
-            continue  # name passed by keyword — obs-internal style only
-        arg = node.args[arg_idx]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            if not taxonomy.is_registered(kind, arg.value):
-                out.append((rel, node.lineno,
-                            f"unregistered {kind} name {arg.value!r}"))
-        elif isinstance(arg, ast.JoinedStr):
-            prefix = _joined_prefix(arg)
-            if not taxonomy.prefix_registered(kind, prefix):
-                out.append((rel, node.lineno,
-                            f"unregistered dynamic {kind} family "
-                            f"(literal prefix {prefix!r} matches no "
-                            f"wildcard entry)"))
-        elif not in_obs:
-            out.append((rel, node.lineno,
-                        f"computed {kind} name (only literals/f-strings "
-                        f"are lintable; computed names are reserved to "
-                        f"sml_tpu/obs/)"))
-    return out
-
-
-def _load_taxonomy():
-    """Load sml_tpu/obs/taxonomy.py by path: the registry is pure data
-    and the lint must not pay (or require) a full jax-importing package
-    load to run."""
-    import importlib.util
-    path = os.path.join(PKG, "obs", "taxonomy.py")
-    spec = importlib.util.spec_from_file_location("_obs_taxonomy", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def check_tree(root: str = PKG) -> List[Tuple[str, int, str]]:
-    taxonomy = _load_taxonomy()
-    violations: List[Tuple[str, int, str]] = []
-    for dirpath, _dirs, files in os.walk(root):
-        for f in sorted(files):
-            if f.endswith(".py"):
-                violations.extend(
-                    check_file(os.path.join(dirpath, f), taxonomy))
-    return violations
-
-
-def main() -> int:
-    violations = check_tree()
-    for rel, line, msg in violations:
-        print(f"{rel}:{line}: {msg}")
-    if violations:
-        print(f"{len(violations)} taxonomy violation(s); register the "
-              f"name in sml_tpu/obs/taxonomy.py or fix the call site")
-        return 1
-    print("obs taxonomy clean")
-    return 0
+TARGETS = _taxonomy_rule.TARGETS
+check_file = _taxonomy_rule.check_file
+check_tree = _taxonomy_rule.check_tree
+_load_taxonomy = _taxonomy_rule.load_taxonomy
+main = _taxonomy_rule.cli_main
 
 
 if __name__ == "__main__":

@@ -12,7 +12,6 @@ family + hyperparameters (linear / random forest / boosted trees from
 
 from __future__ import annotations
 
-import time
 import types
 from typing import Any, Dict, List, Optional
 
@@ -26,6 +25,7 @@ from .ml.regression import GBTRegressor, LinearRegression, RandomForestRegressor
 from .ml.classification import (GBTClassifier, LogisticRegression,
                                 RandomForestClassifier)
 from .tune import STATUS_OK, Trials, fmin, hp, tpe
+from .utils.profiler import wallclock
 
 
 class TrialInfo:
@@ -82,10 +82,10 @@ def _build_feature_pipeline(df, target_col: str):
 def _search(df, target_col: str, primary_metric: str, timeout_minutes: float,
             max_trials: int, task: str, experiment_name: Optional[str]) -> AutoMLSummary:
     exp = mlflow.set_experiment(experiment_name or
-                                f"automl-{task}-{target_col}-{int(time.time())}")
+                                f"automl-{task}-{target_col}-{int(wallclock())}")
     feature_stages = _build_feature_pipeline(df, target_col)
     train, val = df.randomSplit([0.8, 0.2], seed=42)
-    deadline = time.time() + timeout_minutes * 60
+    deadline = wallclock() + timeout_minutes * 60
 
     if task == "regress":
         evaluator = RegressionEvaluator(labelCol=target_col,
@@ -126,7 +126,7 @@ def _search(df, target_col: str, primary_metric: str, timeout_minutes: float,
     infos: List[TrialInfo] = []
 
     def objective(params):
-        if time.time() > deadline:
+        if wallclock() > deadline:
             return {"status": "fail", "error": "timeout"}
         family = params["family"]
         est = families[family](params)

@@ -48,7 +48,9 @@ _register("spark.sql.execution.arrow.maxRecordsPerBatch", 10000, int, "Alias kep
 _register("sml.delta.retentionDurationCheck.enabled", True, _to_bool, "Refuse vacuum(0) unless disabled")
 _register("spark.databricks.delta.retentionDurationCheck.enabled", True, _to_bool, "Alias for course compatibility")
 _register("sml.default.parallelism", 8, int, "Default partition count for new data sources")
-_register("sml.tpu.mesh.axis", "data", str, "Default 1-D mesh axis name")
+# (sml.tpu.mesh.axis was registered here until PR 3: the mesh axis name is
+# the parallel.mesh.DATA_AXIS constant and the knob was never read — the
+# graftlint conf-key-registry rule now keeps such dead keys out.)
 _register("sml.tpu.donate", True, _to_bool, "Donate input buffers on training steps")
 _register("sml.profiler.enabled", False, _to_bool, "Record op-level timings")
 _register("sml.applyInPandas.parallelism", 8, int,
@@ -122,6 +124,11 @@ _register("sml.obs.autoLogRunMetrics", True, _to_bool,
           "an active tracking run logs engine.* metrics (h2d/d2h bytes, "
           "cache hit rates, route mix, compile count, peak HBM ledger "
           "bytes) to the run — the MLflow system-metrics equivalent")
+_register("sml.training.module-name", "", str,
+          "Course module name stamped by the Classroom-Setup shim "
+          "(courseware.CourseConfig)")
+_register("sml.training.username", "", str,
+          "Course username stamped by the Classroom-Setup shim")
 _register("sml.cv.batchFolds", False, _to_bool,
           "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
           "map into one vmapped device program for tree regressors. "
@@ -169,6 +176,15 @@ class TpuConf:
                 return ent.default
             if default is not None:
                 return default
+            if key.startswith(("sml.", "spark.")):
+                import difflib
+                near = difflib.get_close_matches(key, _KNOWN, n=3,
+                                                 cutoff=0.6)
+                hint = ("; did you mean: " + ", ".join(near)
+                        if near else "")
+                raise KeyError(
+                    f"No such config key: {key!r} — not registered in "
+                    f"sml_tpu/conf.py and never set(){hint}")
             raise KeyError(f"No such config key: {key}")
 
     def getInt(self, key: str) -> int:
@@ -186,6 +202,22 @@ class TpuConf:
             d = {k: e.default for k, e in _KNOWN.items()}
             d.update(self._values)
             return d
+
+
+def registered_keys() -> tuple:
+    """Every registered key, sorted — the programmatic registry dump the
+    graftlint conf-key-registry rule cross-checks call sites against
+    (conf.py stays importable by path with zero heavy deps for exactly
+    this reason)."""
+    return tuple(sorted(_KNOWN))
+
+
+def describe() -> Dict[str, Dict[str, Any]]:
+    """key -> {default, type, doc} for the full registry (late registrars
+    like parallel.dispatch appear once they have imported)."""
+    return {k: {"default": e.default, "type": type(e.default).__name__,
+                "doc": e.doc}
+            for k, e in sorted(_KNOWN.items())}
 
 
 _ALIASES = {

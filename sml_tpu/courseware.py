@@ -29,6 +29,7 @@ import pandas as pd
 from .conf import GLOBAL_CONF
 from .frame.session import get_session
 from .native.hashing import hash_columns
+from .utils.profiler import wallclock
 
 
 class FILL_IN:
@@ -117,7 +118,7 @@ class ClassroomSetup:
         dups.to_csv(os.path.join(dedup_dir, "people-with-dups.txt"),
                     index=False, sep=":")
         with open(marker, "w") as f:
-            f.write(str(time.time()))
+            f.write(str(wallclock()))
         return self.datasets_dir
 
     def path_exists(self, path: str) -> bool:
@@ -335,8 +336,8 @@ def until_stream_is_ready(query, min_batches: int = 2,
                           timeout_s: float = 60.0) -> None:
     """Poll a streaming query until it has processed batches
     (`Classroom-Setup.py:96-110`)."""
-    start = time.time()
-    while time.time() - start < timeout_s:
+    start = wallclock()
+    while wallclock() - start < timeout_s:
         if getattr(query, "isActive", False) and \
                 len(getattr(query, "recentProgress", [])) >= min_batches:
             return
@@ -352,8 +353,8 @@ def wait_for_model(name: str, version: int, stage: Optional[str] = None,
     """Registry-readiness polling (`Labs/ML 05L:179-199`)."""
     from . import tracking
     client = tracking.MlflowClient()
-    start = time.time()
-    while time.time() - start < timeout_s:
+    start = wallclock()
+    while wallclock() - start < timeout_s:
         try:
             mv = client.get_model_version(name, version)
             if mv.status == "READY" and (stage is None or

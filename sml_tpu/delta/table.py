@@ -18,7 +18,6 @@ import glob
 import json
 import os
 import shutil
-import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -27,6 +26,7 @@ import pyarrow.parquet as pq
 
 from ..conf import GLOBAL_CONF
 from ..frame.dataframe import DataFrame, _concat
+from ..utils.profiler import wallclock
 
 LOG_DIR = "_delta_log"
 
@@ -86,7 +86,7 @@ def write_delta(df: DataFrame, path: str, mode: str = "errorifexists",
     new_cols = df.columns
     actions: List[Dict[str, Any]] = [{
         "commitInfo": {
-            "timestamp": int(time.time() * 1000),
+            "timestamp": int(wallclock() * 1000),
             "operation": "WRITE",
             "operationParameters": {"mode": mode.upper(),
                                     "partitionBy": json.dumps(partition_by)},
@@ -116,13 +116,13 @@ def write_delta(df: DataFrame, path: str, mode: str = "errorifexists",
         if mode == "overwrite":
             for f in prev["files"]:
                 actions.append({"remove": {"path": f["path"],
-                                           "deletionTimestamp": int(time.time() * 1000)}})
+                                           "deletionTimestamp": int(wallclock() * 1000)}})
 
     schema_string = json.dumps([{"name": c, "type": t} for c, t in df.dtypes])
     actions.append({"metaData": {"id": str(uuid.uuid4()),
                                  "schemaString": schema_string,
                                  "partitionColumns": partition_by,
-                                 "createdTime": int(time.time() * 1000)}})
+                                 "createdTime": int(wallclock() * 1000)}})
 
     os.makedirs(path, exist_ok=True)
     parts = df._materialize()
@@ -139,7 +139,7 @@ def write_delta(df: DataFrame, path: str, mode: str = "errorifexists",
             pq.write_table(_pandas_to_arrow(body), os.path.join(path, rel))
             actions.append({"add": {"path": rel, "size": os.path.getsize(os.path.join(path, rel)),
                                     "partitionValues": {k: str(v) for k, v in zip(partition_by, keys)},
-                                    "modificationTime": int(time.time() * 1000),
+                                    "modificationTime": int(wallclock() * 1000),
                                     "numRecords": len(body), "dataChange": True}})
     else:
         for i, p in enumerate(parts):
@@ -147,7 +147,7 @@ def write_delta(df: DataFrame, path: str, mode: str = "errorifexists",
             pq.write_table(_pandas_to_arrow(p), os.path.join(path, rel))
             actions.append({"add": {"path": rel, "size": os.path.getsize(os.path.join(path, rel)),
                                     "partitionValues": {},
-                                    "modificationTime": int(time.time() * 1000),
+                                    "modificationTime": int(wallclock() * 1000),
                                     "numRecords": len(p), "dataChange": True}})
 
     _write_commit(path, new_version, actions)
@@ -238,7 +238,7 @@ class DeltaTable:
         versions = _list_versions(self._path)
         latest = _snapshot(self._path, versions[-1])
         live = {f["path"] for f in latest["files"]}
-        cutoff = time.time() - retentionHours * 3600
+        cutoff = wallclock() - retentionHours * 3600
         for root, _dirs, files in os.walk(self._path):
             for f in files:
                 full = os.path.join(root, f)

@@ -1,0 +1,29 @@
+"""graftlint — engine-invariant static analysis for the sml_tpu tree.
+
+The engine has invariants no runtime test can economically check: every
+compile must flow through the dispatch layer (or the routing audit and
+compile cache lie), donated buffers must never be read after dispatch
+(XLA:CPU forgives what a TPU will not), hot paths must not silently sync
+device->host, conf-key literals must exist in the conf.py registry, obs
+names must match the taxonomy, and engine timestamps must come from the
+profiler's clock. graftlint turns each of those into an AST rule with
+per-line pragmas, a reviewed baseline, and CI enforcement
+(tests/test_lint_clean.py).
+
+Run it:            python scripts/graftlint.py
+Suppress a line:   # graftlint: disable=<rule> -- <reason>
+Carry a debt:      .graftlint-baseline.json (reviewed reasons mandatory)
+Docs:              docs/LINTING.md
+
+This package is stdlib-only and is loaded STANDALONE by the runner
+(importlib by path, package name "graftlint") so linting never imports
+sml_tpu or jax — keep every import in here relative.
+"""
+
+from .core import META_RULES, RULES, Rule, Violation, rule  # noqa: F401
+from .project import Project  # noqa: F401
+from . import rules as _rules  # noqa: F401  (registers the built-ins)
+from .engine import Report, run  # noqa: F401
+
+__all__ = ["run", "Report", "Project", "Violation", "Rule", "rule",
+           "RULES", "META_RULES"]

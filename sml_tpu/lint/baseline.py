@@ -1,0 +1,131 @@
+"""The committed violation baseline.
+
+`.graftlint-baseline.json` (repo root) holds the violations the team has
+looked at and decided to carry — each entry names the rule, the file,
+the offending source line (stripped; line numbers drift, code lines
+rarely do), and a mandatory human reason:
+
+    {"entries": [
+      {"rule": "dispatch-bypass",
+       "file": "sml_tpu/timeseries.py",
+       "code": "loss_j = jax.jit(loss)",
+       "reason": "ARIMA CSS loss rides scipy's host optimizer; ..."}]}
+
+Hygiene mirrors the pragma rules: entries with a missing/TODO reason and
+entries matching nothing in the tree (fixed code, stale baseline) are
+reported under `graftlint-baseline`, so the baseline only ever shrinks
+through real fixes and can never rot silently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Violation
+
+DEFAULT_BASENAME = ".graftlint-baseline.json"
+
+
+def load(path: str) -> List[Dict[str, str]]:
+    if not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("entries", []))
+
+
+def save(path: str, entries: List[Dict[str, str]]) -> None:
+    entries = sorted(entries, key=lambda e: (e.get("file", ""),
+                                             e.get("rule", ""),
+                                             e.get("code", "")))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=1)
+        fh.write("\n")
+
+
+def _matches(entry: Dict[str, str], v: Violation) -> bool:
+    return (entry.get("rule") == v.rule
+            and entry.get("file") == v.path
+            and entry.get("code", "") == v.snippet)
+
+
+def apply(violations: List[Violation], entries: List[Dict[str, str]],
+          baseline_rel: str = DEFAULT_BASENAME,
+          active_rules: Optional[Iterable[str]] = None
+          ) -> Tuple[List[Violation], List[Violation]]:
+    """(kept violations, baseline-hygiene violations).
+
+    Each entry suppresses at most `count` occurrences (default 1) of its
+    (rule, file, code) fingerprint — a second identical violating line
+    added later is NOT silently blessed by an existing entry. Hygiene
+    (reason / stale / over-count) only judges entries whose rule is in
+    `active_rules` (None = all), so a partial `--rule NAME` run cannot
+    flag another rule's entries as stale."""
+    active = set(active_rules) if active_rules is not None else None
+    matched = [0] * len(entries)
+    kept: List[Violation] = []
+    for v in violations:
+        hit = None
+        for i, e in enumerate(entries):
+            if _matches(e, v) and matched[i] < int(e.get("count", 1)):
+                hit = i
+                break
+        if hit is None:
+            kept.append(v)
+        else:
+            matched[hit] += 1
+
+    meta: List[Violation] = []
+    for i, e in enumerate(entries):
+        if active is not None and e.get("rule") not in active:
+            continue
+        label = f"{e.get('rule', '?')} @ {e.get('file', '?')}"
+        reason = (e.get("reason") or "").strip()
+        count = int(e.get("count", 1))
+        if not reason or reason.upper().startswith("TODO"):
+            meta.append(Violation(
+                "graftlint-baseline", baseline_rel, 1,
+                f"baseline entry [{label}] has no reviewed reason "
+                f"(reason={reason!r}) — justify or fix the violation"))
+        if matched[i] == 0:
+            meta.append(Violation(
+                "graftlint-baseline", baseline_rel, 1,
+                f"stale baseline entry [{label}] matches nothing in the "
+                f"tree — the violation was fixed; delete the entry"))
+        elif matched[i] < count:
+            meta.append(Violation(
+                "graftlint-baseline", baseline_rel, 1,
+                f"baseline entry [{label}] carries count={count} but only "
+                f"{matched[i]} occurrence(s) remain — shrink the count"))
+    return kept, meta
+
+
+def update(path: str, violations: List[Violation],
+           existing: Optional[List[Dict[str, str]]] = None
+           ) -> List[Dict[str, str]]:
+    """--update-baseline: re-emit entries for the current violations,
+    keeping reviewed reasons for entries that still match and stamping
+    new ones with a TODO reason (which graftlint then flags until a
+    human edits it — an unreviewed baseline never passes)."""
+    existing = existing if existing is not None else load(path)
+    counts: Dict[tuple, int] = {}
+    for v in violations:
+        key = (v.rule, v.path, v.snippet)
+        counts[key] = counts.get(key, 0) + 1
+    out: List[Dict[str, str]] = []
+    for (vrule, vpath, vsnippet), n in counts.items():
+        reason = "TODO: justify this suppression"
+        for e in existing:
+            if (e.get("rule") == vrule and e.get("file") == vpath
+                    and e.get("code", "") == vsnippet):
+                reason = e.get("reason", reason)
+                break
+        entry: Dict[str, object] = {"rule": vrule, "file": vpath,
+                                    "code": vsnippet, "reason": reason}
+        if n > 1:
+            entry["count"] = n
+        out.append(entry)
+    save(path, out)
+    return out

@@ -1,0 +1,76 @@
+"""graftlint primitives: violations, the rule registry, parsed sources.
+
+Everything in this package is stdlib-only and importable WITHOUT the
+`sml_tpu` package (and therefore without jax): `scripts/graftlint.py`
+loads it standalone via `importlib` so CI can lint the tree in
+milliseconds from a cold interpreter. Keep imports relative and
+jax/numpy-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding. `snippet` is the stripped source line at `line` —
+    the line-number-independent fingerprint baseline entries match on."""
+    rule: str
+    path: str          # repo-relative, "/"-separated
+    line: int
+    message: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable  # (Project) -> List[Violation]
+
+
+#: name -> Rule; populated by the @rule decorator when `rules/` imports.
+RULES: Dict[str, Rule] = {}
+
+#: rule names the engine itself emits (pragma/baseline hygiene, parse
+#: errors). They are not suppressible and not listed as "active rules".
+META_RULES = ("graftlint-pragma", "graftlint-baseline", "syntax-error")
+
+
+def rule(name: str, doc: str):
+    """Register a rule function `(project) -> [Violation]` under `name`."""
+    def deco(fn):
+        RULES[name] = Rule(name, doc, fn)
+        return fn
+    return deco
+
+
+class SourceFile:
+    """One file under lint: raw text, physical lines, parsed AST.
+
+    `tree` is None when the file does not parse; the engine reports that
+    as a `syntax-error` violation instead of crashing the run.
+    """
+
+    def __init__(self, rel: str, text: str, path: Optional[str] = None):
+        self.rel = rel.replace("\\", "/")
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text, filename=rel)
+            self.parse_error: Optional[SyntaxError] = None
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""

@@ -1,0 +1,87 @@
+"""Rule 3 — conf-key-registry.
+
+The `conf.py` registry is the contract between knob producers and
+consumers. Two failure modes, both flagged:
+
+- an UNREGISTERED literal at a call site — `get/getInt/getBool/set/
+  unset/on_set("sml.*" | "spark.*")` whose key no `_register(...)`
+  declares: a typo'd knob silently falls back to free-form-string
+  behavior and the documented default never applies;
+- a DEAD key — registered but with zero literal call sites anywhere
+  under the linted tree OR tests/ (tests count as evidence of life:
+  some knobs exist for test control). Registered-but-unread knobs are
+  documentation lying about what the engine honors.
+
+The registry is the AST union of every `_register("key", ...)` in the
+linted tree (conf.py plus late registrars like parallel/dispatch.py),
+cross-checked with the programmatic dump `conf.registered_keys()` when
+conf.py is loadable (it is jax-free by design). `spark.* <-> sml.*`
+alias pairs (conf._ALIASES) count as one key for liveness.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, List, Set, Tuple
+
+from ..core import SourceFile, Violation, rule
+from ..project import Project
+
+CONF_METHODS = {"get", "getInt", "getBool", "set", "unset", "on_set"}
+KEY_PREFIXES = ("sml.", "spark.")
+
+
+def _literal_key_sites(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(key, line) for every conf-method call with a literal key arg."""
+    out: List[Tuple[str, int]] = []
+    if sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in CONF_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        key = node.args[0].value
+        if key.startswith(KEY_PREFIXES):
+            out.append((key, node.lineno))
+    return out
+
+
+@rule("conf-key-registry",
+      "every sml.*/spark.* conf literal must resolve against the conf.py "
+      "registry; registered keys with zero call sites are dead")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    registry = project.conf_registry()
+    aliases = project.conf_aliases()
+
+    live: Set[str] = set()
+    for sf in list(project.files) + list(project.extra_files):
+        linted = sf.rel in project.by_rel
+        for key, line in _literal_key_sites(sf):
+            live.add(key)
+            if linted and key not in registry:
+                near = difflib.get_close_matches(key, registry, n=3,
+                                                 cutoff=0.6)
+                hint = (" — did you mean: " + ", ".join(near)
+                        if near else "")
+                out.append(Violation(
+                    "conf-key-registry", sf.rel, line,
+                    f"conf key {key!r} is not registered (no "
+                    f"_register(...) in conf.py or a late registrar)"
+                    f"{hint}"))
+
+    for key, (rel, line) in sorted(registry.items()):
+        group = {key, aliases.get(key, key)}
+        if group & live:
+            continue
+        out.append(Violation(
+            "conf-key-registry", rel, line,
+            f"registered conf key {key!r} has no literal call site under "
+            f"the linted tree or tests/ — dead key; wire it up or delete "
+            f"the registration"))
+    return out

@@ -1,0 +1,120 @@
+"""Rule 2 — dispatch-bypass.
+
+Every compile in the engine is supposed to flow through
+`parallel/dispatch.py`-governed paths (the `data_parallel` /
+`cached_data_parallel` helpers and the tree program caches) so that the
+PR-2 routing audit, `obs.note_compile`, and the persistent compile cache
+stay authoritative. A bare `jax.jit` / `pjit` / `pmap` anywhere else is a
+compile the observability stack never sees.
+
+Flagged forms (call or decorator):  `jax.jit(...)`, `pjit(...)`,
+`jax.pmap(...)`, `@jax.jit`, `@partial(jax.jit, ...)`.
+
+Suppression is an explicit ALLOWLIST of (file, enclosing function)
+pairs, each carrying its justification — the blessed compile owners —
+plus the usual pragma/baseline machinery for one-offs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Violation, rule
+from ..project import Project
+
+COMPILE_ATTRS = {"jit", "pjit", "pmap"}
+
+#: rel -> {enclosing qualname ("<module>" for module level) -> reason}
+ALLOWLIST: Dict[str, Dict[str, str]] = {
+    "sml_tpu/parallel/dispatch.py": {
+        "*": "the dispatcher itself: calibration probes and the compile "
+             "cache are this rule's ground truth",
+    },
+    "sml_tpu/ml/_staging.py": {
+        "data_parallel": "THE blessed jit+shard_map compile helper; every "
+                         "cached build is reported via obs.note_compile in "
+                         "cached_data_parallel",
+    },
+    "sml_tpu/ml/tree_impl.py": {
+        "_compiled_chunk": "chunked-boosting program cache; each build is "
+                           "reported via obs.note_compile('tree_chunk_*')",
+        "fit_ensembles_folds": "batched CV-folds program cache; builds are "
+                               "reported via obs.note_compile("
+                               "'tree_ensemble_folds_*')",
+        "_predict_binned": "module-level predict kernel (static depth); "
+                           "host-side predict path whose traffic is visible "
+                           "through the binning.predict span",
+    },
+}
+
+
+def _is_jax_jit_expr(e: ast.expr) -> bool:
+    """jax.jit / jax.pjit / jax.pmap as an attribute, or a bare pjit name."""
+    if isinstance(e, ast.Attribute):
+        return (isinstance(e.value, ast.Name) and e.value.id == "jax"
+                and e.attr in COMPILE_ATTRS)
+    if isinstance(e, ast.Name):
+        return e.id in ("pjit",)
+    return False
+
+
+def _compile_site(node: ast.expr) -> Optional[str]:
+    """A human label when `node` is a compile constructor, else None."""
+    if _is_jax_jit_expr(node):
+        return ast.unparse(node) if hasattr(ast, "unparse") else "jax.jit"
+    if isinstance(node, ast.Call):
+        if _is_jax_jit_expr(node.func):
+            return ast.unparse(node.func) if hasattr(ast, "unparse") \
+                else "jax.jit"
+        # partial(jax.jit, ...) — the decorator spelling for static args
+        if (isinstance(node.func, ast.Name) and node.func.id == "partial"
+                and node.args and _is_jax_jit_expr(node.args[0])):
+            return "partial(jax.jit, ...)"
+    return None
+
+
+@rule("dispatch-bypass",
+      "bare jax.jit/pjit/pmap compiles outside parallel/dispatch.py must "
+      "be allowlisted compile owners")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        allow = ALLOWLIST.get(f.rel, {})
+        if "*" in allow:
+            continue
+
+        def report(node: ast.AST, label: str,
+                   qual: Optional[str] = None) -> None:
+            if qual is None:
+                fn = project.enclosing_function(f.rel, node.lineno)
+                qual = fn.qualname if fn else "<module>"
+            if qual in allow or qual.rsplit(".", 1)[-1] in allow:
+                return
+            out.append(Violation(
+                "dispatch-bypass", f.rel, node.lineno,
+                f"bare `{label}` compile in `{qual}` bypasses "
+                f"parallel.dispatch (routing audit + obs.note_compile + "
+                f"compile cache never see it) — compile through "
+                f"ml._staging.data_parallel/cached_data_parallel or add "
+                f"an allowlist entry with a reason"))
+
+        seen_decorators = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    label = _compile_site(dec)
+                    if label is not None:
+                        seen_decorators.add(id(dec))
+                        info = project.enclosing_function(f.rel, node.lineno)
+                        report(dec, f"@{label}",
+                               qual=info.qualname if info else node.name)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) and id(node) not in seen_decorators:
+                label = _compile_site(node)
+                # only the call form here; bare attributes were decorators
+                if label is not None and not _is_jax_jit_expr(node):
+                    report(node, label)
+    return out

@@ -1,0 +1,187 @@
+"""Rule 4 — donation-after-use.
+
+`jax.jit(..., donate_argnums=...)` hands the argument's HBM to XLA: the
+caller's array handle is deleted on dispatch and a later read returns
+garbage/raises (on backends that honor donation — XLA:CPU ignores it,
+which is exactly why such a bug survives the CPU test mesh and detonates
+on the TPU). The engine's one donation site is the chunked boosting
+margin carry; this rule keeps any future ones honest.
+
+Detection is a per-function, statement-ordered taint scan:
+
+- `f = jax.jit(g, donate_argnums=(k, ...))` marks `f` as donating k;
+- `jax.jit(g, donate_argnums=...)(args...)` is handled directly;
+- `_compiled_chunk(...)` (the known donating program cache — margin is
+  arg 3 when `sml.tpu.donate` is on) is registered in KNOWN_DONATING;
+- at a donating call, the NAME passed in each donated position is
+  poisoned; any later Name read in the same function flags, until the
+  name is rebound (the legal idiom: `margin, _ = step(..., margin, ...)`
+  rebinds in the same statement and stays clean) or deleted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Violation, rule
+from ..project import Project
+
+#: function name -> donated positional indices of the RETURNED program.
+#: `_compiled_chunk` donates the margin carry (arg 3) on real devices —
+#: see tree_impl._compiled_chunk; keep in sync when adding donating
+#: program caches.
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {"_compiled_chunk": (3,)}
+
+
+def _donate_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a jax.jit(...) call, when statically literal."""
+    is_jit = (isinstance(call.func, ast.Attribute)
+              and call.func.attr == "jit"
+              and isinstance(call.func.value, ast.Name)
+              and call.func.value.id == "jax") \
+        or (isinstance(call.func, ast.Name) and call.func.id == "jit")
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            idxs = []
+            for elt in v.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return ()  # dynamic: can't reason statically
+                idxs.append(elt.value)
+            return tuple(idxs)
+        return ()  # dynamic donate tuple (e.g. conf-dependent): skip
+    return None
+
+
+class _FnScan:
+    def __init__(self, rel: str, qualname: str):
+        self.rel = rel
+        self.qualname = qualname
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self.poisoned: Dict[str, int] = {}  # name -> line it was donated at
+        self.out: List[Violation] = []
+
+    def _donated_call_indices(self, call: ast.Call) -> Tuple[int, ...]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.donating:
+            return self.donating[f.id]
+        if isinstance(f, ast.Call):
+            inner = f.func
+            name = inner.id if isinstance(inner, ast.Name) else (
+                inner.attr if isinstance(inner, ast.Attribute) else None)
+            if name in KNOWN_DONATING:
+                return KNOWN_DONATING[name]
+            idxs = _donate_indices(f) if isinstance(f, ast.Call) else None
+            if idxs:
+                return idxs
+        return ()
+
+    def _scan_expr(self, e: ast.expr) -> None:
+        # reads of poisoned names first (args are evaluated before the
+        # call consumes them, and before any same-statement rebind)
+        for node in ast.walk(e):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in self.poisoned):
+                self.out.append(Violation(
+                    "donation-after-use", self.rel, node.lineno,
+                    f"`{node.id}` was donated to a dispatch at line "
+                    f"{self.poisoned[node.id]} in `{self.qualname}`; its "
+                    f"buffer belongs to XLA now — reading it is undefined "
+                    f"on donating backends (rebind the name from the "
+                    f"program's result instead)"))
+                del self.poisoned[node.id]  # one report per donation
+        # then poison names consumed by donating calls
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            for idx in self._donated_call_indices(node):
+                if idx < len(node.args):
+                    arg = node.args[idx]
+                    if isinstance(arg, ast.Name):
+                        self.poisoned[arg.id] = node.lineno
+
+    def _bind(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.poisoned.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value)
+
+    def run(self, fn_node: ast.AST) -> List[Violation]:
+        for stmt in fn_node.body:
+            self._stmt(stmt)
+        return self.out
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            if (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                idxs = _donate_indices(stmt.value)
+                if idxs:
+                    self.donating[stmt.targets[0].id] = idxs
+            for t in stmt.targets:
+                self._bind(t)
+            return
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+            self._bind(stmt.target)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            self._bind(stmt.target)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.While, ast.If)):
+            self._scan_expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody
+                      + [h for hh in stmt.handlers for h in hh.body]):
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._bind(t)
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._scan_expr(node)
+
+
+@rule("donation-after-use",
+      "a name passed in a donated argument position must not be read "
+      "after the dispatch until rebound")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, fns in project.function_index().items():
+        for fn in fns:
+            out.extend(_FnScan(rel, fn.qualname).run(fn.node))
+    return out

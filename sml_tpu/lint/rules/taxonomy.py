@@ -1,0 +1,172 @@
+"""Rule 5 — obs-taxonomy (the PR-2 name-taxonomy lint, re-homed).
+
+AST-greps every `PROFILER.span(...)` / `PROFILER.count(...)` and
+`RECORDER.emit/counter/gauge(...)` call site under sml_tpu/ and checks
+the event/span/counter name against the registered dotted-name taxonomy
+(`sml_tpu/obs/taxonomy.py`), so names cannot silently drift between the
+modules that emit them and the report/exporter/autologger that read them.
+
+- a literal string name must be registered (exactly, or under a
+  `prefix.*` wildcard);
+- an f-string name's literal prefix (the part before the first
+  interpolation) must sit under a registered wildcard — dynamic suffixes
+  are only legal for registered families;
+- any other (computed) name argument is a violation OUTSIDE sml_tpu/obs/
+  (the recorder itself forwards names that originated at checked call
+  sites; everyone else must write literals).
+
+`scripts/check_obs_taxonomy.py` is now a thin deprecation shim over the
+helpers here (`check_file` / `check_tree` / `load_taxonomy` / `cli_main`
+keep the original tuple-based API so tests/test_obs_taxonomy.py runs
+unchanged).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from ..core import Violation, rule
+from ..project import Project
+
+# receiver name -> {method -> (arg index of the NAME, taxonomy kind)}
+TARGETS = {
+    "PROFILER": {"span": (0, "span"), "count": (0, "count")},
+    "RECORDER": {"emit": (1, "emit"), "counter": (0, "counter"),
+                 "gauge": (0, "gauge")},
+    "_OBS": {"emit": (1, "emit"), "counter": (0, "counter"),
+             "gauge": (0, "gauge")},
+}
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+#: .../sml_tpu/lint/rules -> repo root
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
+PKG = os.path.join(REPO, "sml_tpu")
+
+
+def _receiver_name(node: ast.expr) -> str:
+    """The identifier a method is called on: PROFILER.span -> "PROFILER",
+    obs.RECORDER.emit -> "RECORDER"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _joined_prefix(node: ast.JoinedStr) -> str:
+    """Literal prefix of an f-string up to the first interpolation."""
+    prefix = ""
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            prefix += part.value
+        else:
+            break
+    return prefix
+
+
+def _is_obs_internal(rel: str) -> bool:
+    """The event bus itself (obs/) and its front-end (utils/profiler.py)
+    forward names that were linted at their ORIGINATING call sites."""
+    rel = rel.replace("\\", "/")
+    return "/obs/" in f"/{rel}" or rel.endswith("utils/profiler.py")
+
+
+def check_source(text: str, rel: str, taxonomy,
+                 in_obs: bool) -> List[Tuple[str, int, str]]:
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        methods = TARGETS.get(_receiver_name(node.func.value))
+        if methods is None or node.func.attr not in methods:
+            continue
+        arg_idx, kind = methods[node.func.attr]
+        if len(node.args) <= arg_idx:
+            continue  # name passed by keyword — obs-internal style only
+        arg = node.args[arg_idx]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not taxonomy.is_registered(kind, arg.value):
+                out.append((rel, node.lineno,
+                            f"unregistered {kind} name {arg.value!r}"))
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = _joined_prefix(arg)
+            if not taxonomy.prefix_registered(kind, prefix):
+                out.append((rel, node.lineno,
+                            f"unregistered dynamic {kind} family "
+                            f"(literal prefix {prefix!r} matches no "
+                            f"wildcard entry)"))
+        elif not in_obs:
+            out.append((rel, node.lineno,
+                        f"computed {kind} name (only literals/f-strings "
+                        f"are lintable; computed names are reserved to "
+                        f"sml_tpu/obs/)"))
+    return out
+
+
+def load_taxonomy(repo: str = REPO):
+    """Load sml_tpu/obs/taxonomy.py by path: the registry is pure data
+    and the lint must not pay (or require) a full jax-importing package
+    load to run."""
+    import importlib.util
+    path = os.path.join(repo, "sml_tpu", "obs", "taxonomy.py")
+    spec = importlib.util.spec_from_file_location("_obs_taxonomy", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_file(path: str, taxonomy) -> List[Tuple[str, int, str]]:
+    rel = os.path.relpath(path, REPO)
+    in_obs = (os.sep + "obs" + os.sep in path
+              or path.endswith(os.path.join("utils", "profiler.py")))
+    with open(path, encoding="utf-8") as fh:
+        return check_source(fh.read(), rel, taxonomy, in_obs)
+
+
+def check_tree(root: str = PKG) -> List[Tuple[str, int, str]]:
+    taxonomy = load_taxonomy()
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                violations.extend(
+                    check_file(os.path.join(dirpath, f), taxonomy))
+    return violations
+
+
+def cli_main() -> int:
+    """The original check_obs_taxonomy.py CLI behavior, kept for the shim."""
+    violations = check_tree()
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} taxonomy violation(s); register the "
+              f"name in sml_tpu/obs/taxonomy.py or fix the call site")
+        return 1
+    print("obs taxonomy clean")
+    return 0
+
+
+@rule("obs-taxonomy",
+      "PROFILER/RECORDER span/counter/event names must be registered in "
+      "sml_tpu/obs/taxonomy.py")
+def check(project: Project) -> List[Violation]:
+    taxonomy = load_taxonomy(project.root
+                             if os.path.isdir(os.path.join(
+                                 project.root, "sml_tpu", "obs"))
+                             else REPO)
+    out: List[Violation] = []
+    for f in project.files:
+        if not f.rel.startswith("sml_tpu/") or f.rel.startswith("sml_tpu/lint/"):
+            continue
+        for rel, line, msg in check_source(f.text, f.rel, taxonomy,
+                                           _is_obs_internal(f.rel)):
+            out.append(Violation("obs-taxonomy", rel, line, msg))
+    return out

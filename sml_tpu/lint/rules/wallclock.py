@@ -1,0 +1,64 @@
+"""Rule 6 — no-wallclock-in-engine.
+
+The flight recorder's timeline (and the dispatch audit's measured walls)
+are only complete if every timing in the engine flows through ONE clock:
+`utils/profiler.py` (spans, `now()`, `wallclock()`). A module-private
+`time.time()` / `time.perf_counter()` produces timestamps the recorder
+can never correlate — and domain timestamps written with a second clock
+drift against the event ring's epoch.
+
+Flags `time.time()` and `time.perf_counter()` calls (attribute form or
+names imported `from time import ...`) everywhere in the linted tree
+EXCEPT `utils/profiler.py` and `obs/` (the clock owners).
+`time.monotonic()` is exempt: it is an aging/arithmetic clock, not a
+timestamp source, and never lands in a timeline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Violation, rule
+from ..project import Project
+
+BANNED = {"time", "perf_counter"}
+EXEMPT_PREFIXES = ("sml_tpu/obs/",)
+EXEMPT_FILES = ("sml_tpu/utils/profiler.py",)
+
+
+@rule("no-wallclock-in-engine",
+      "time.time()/perf_counter() outside utils/profiler.py and obs/ "
+      "must go through the profiler clock")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for f in project.files:
+        if f.tree is None or f.rel in EXEMPT_FILES \
+                or f.rel.startswith(EXEMPT_PREFIXES):
+            continue
+        # names bound by `from time import time, perf_counter [as x]`
+        local_banned: Set[str] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name in BANNED:
+                        local_banned.add(alias.asname or alias.name)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            hit = None
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "time" and fn.attr in BANNED):
+                hit = f"time.{fn.attr}()"
+            elif isinstance(fn, ast.Name) and fn.id in local_banned:
+                hit = f"{fn.id}()"
+            if hit:
+                out.append(Violation(
+                    "no-wallclock-in-engine", f.rel, node.lineno,
+                    f"`{hit}` outside the profiler: use "
+                    f"utils.profiler.now() (monotonic timing) / "
+                    f".wallclock() (epoch timestamps) or a PROFILER.span "
+                    f"so the flight-recorder timeline stays complete"))
+    return out

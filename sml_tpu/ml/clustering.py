@@ -102,7 +102,9 @@ class KMeans(Estimator):
             Xd, mask, _ = stage_sharded(X32)
             program = cached_data_parallel(_lloyd_program(k, max_iter),
                                            replicated_argnums=(2,))
-            final_centers, cost = program(Xd, mask, init)
+            # ONE batched D2H for (centers, cost): per-leaf np.asarray /
+            # float() each pay the tunnel's fixed transfer latency
+            final_centers, cost = jax.device_get(program(Xd, mask, init))
         m = KMeansModel(centers=np.asarray(final_centers),
                         trainingCost=float(cost))
         m._inherit_params(self)

@@ -5,8 +5,10 @@ autologger, and the bench's per-leg counter snapshots all key off these
 names; a call site inventing `staging.h2dBytes` next to
 `staging.h2d_bytes` silently splits a metric in two. Every
 `PROFILER.span`/`PROFILER.count` and `RECORDER.emit/counter/gauge` call
-site is AST-linted against this registry (`scripts/check_obs_taxonomy.py`,
-enforced by tests/test_obs_taxonomy.py).
+site is AST-linted against this registry (graftlint rule `obs-taxonomy`
+in sml_tpu/lint/rules/taxonomy.py — `scripts/check_obs_taxonomy.py` is
+now a shim — enforced by tests/test_obs_taxonomy.py and
+tests/test_lint_clean.py).
 
 Entries are exact names or `prefix.*` wildcards (wildcards cover the
 f-string sites whose suffix is runtime data: the op behind a

@@ -41,6 +41,7 @@ import numpy as np
 from ..conf import GLOBAL_CONF, _register, _to_bool
 from ..obs import _audit as _obs_audit
 from ..obs._recorder import RECORDER as _OBS
+from ..utils.profiler import now as _now
 from . import mesh as meshlib
 
 _register("sml.dispatch.mode", "auto", str,
@@ -216,11 +217,11 @@ def observe_host(kind: str, flops: float):
     """Time a host-route execution and feed the measured rate back into
     the router — the ONE definition of what gets observed, shared by every
     host predict path."""
-    t0 = time.perf_counter()
+    t0 = _now()
     try:
         yield
     finally:
-        OBSERVED_HOST.observe(kind, flops, time.perf_counter() - t0)
+        OBSERVED_HOST.observe(kind, flops, _now() - t0)
 
 
 @dataclass(frozen=True)
@@ -259,24 +260,27 @@ class _Calibration:
             jax.device_get(f(x))  # compile outside the timing
             trips = []
             for _ in range(3):
-                t0 = time.perf_counter()
+                t0 = _now()
                 jax.device_get(f(x))
-                trips.append(time.perf_counter() - t0)
+                trips.append(_now() - t0)
             self.rt_fixed = max(min(trips), 1e-4)
             blk = np.ones((4 * 1024 * 1024,), np.float32)  # 16 MB
             h2d = []
             for _ in range(2):  # best-of-2: tunnel bandwidth is noisy
-                t0 = time.perf_counter()
+                t0 = _now()
                 d = jax.device_put(blk, dev)
+                # graftlint: disable=host-sync-in-hot-path -- calibration probe: the synchronous H2D wait IS the bandwidth measurement
                 d.block_until_ready()
-                h2d.append(time.perf_counter() - t0)
+                h2d.append(_now() - t0)
                 del d
             d = jax.device_put(blk, dev)
+            # graftlint: disable=host-sync-in-hot-path -- calibration probe: drain the transfer before timing the D2H leg
             d.block_until_ready()
             self.h2d_bw = max(blk.nbytes / min(h2d), 1e6)
-            t0 = time.perf_counter()
+            t0 = _now()
+            # graftlint: disable=host-sync-in-hot-path -- calibration probe: the synchronous D2H pull IS the bandwidth measurement
             np.asarray(d)
-            self.d2h_bw = max(blk.nbytes / (time.perf_counter() - t0), 1e6)
+            self.d2h_bw = max(blk.nbytes / (_now() - t0), 1e6)
             self._done = True
             return self
 

@@ -26,6 +26,7 @@ import pandas as pd
 
 from ..frame.dataframe import DataFrame
 from ..frame.types import StructType, parse_schema
+from ..utils.profiler import wallclock
 
 _active_queries: List["StreamingQuery"] = []
 _lock = threading.RLock()
@@ -47,9 +48,9 @@ class StreamManager:
         return None
 
     def awaitAnyTermination(self, timeout: Optional[float] = None) -> None:
-        t0 = time.time()
+        t0 = wallclock()
         while self.active:
-            if timeout is not None and time.time() - t0 > timeout:
+            if timeout is not None and wallclock() - t0 > timeout:
                 return
             time.sleep(0.05)
 
@@ -282,7 +283,7 @@ class StreamingQuery:
         self._save_checkpoint()
         self.recentProgress.append({
             "id": self.id, "name": self.name, "numInputRows": df.count(),
-            "files": batch_files, "timestamp": time.time(),
+            "files": batch_files, "timestamp": wallclock(),
         })
         return True
 

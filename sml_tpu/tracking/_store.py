@@ -21,9 +21,9 @@ import json
 import os
 import shutil
 import threading
-import time
 import uuid
 from typing import Any, Dict, List, Optional
+from ..utils.profiler import wallclock
 
 _lock = threading.RLock()
 _tracking_root: Optional[str] = None
@@ -80,7 +80,7 @@ def get_or_create_experiment(name: str) -> Dict[str, Any]:
                 return exp
         exp_id = new_id()[:12]
         meta = {"experiment_id": exp_id, "name": name,
-                "creation_time": time.time(), "lifecycle_stage": "active"}
+                "creation_time": wallclock(), "lifecycle_stage": "active"}
         d = os.path.join(experiments_dir(), exp_id)
         os.makedirs(d, exist_ok=True)
         _write_json(os.path.join(d, "meta.json"), meta)
@@ -127,7 +127,7 @@ def create_run(exp_id: str, run_name: Optional[str] = None,
     os.makedirs(os.path.join(d, "artifacts"), exist_ok=True)
     meta = {"run_id": run_id, "experiment_id": exp_id,
             "run_name": run_name or f"run-{run_id[:8]}",
-            "status": "RUNNING", "start_time": time.time(), "end_time": None,
+            "status": "RUNNING", "start_time": wallclock(), "end_time": None,
             "artifact_uri": os.path.join(d, "artifacts")}
     _write_json(os.path.join(d, "meta.json"), meta)
     t = dict(tags or {})
@@ -145,7 +145,7 @@ def end_run(exp_id: str, run_id: str, status: str = "FINISHED") -> None:
     d = run_dir(exp_id, run_id)
     meta = _read_json(os.path.join(d, "meta.json"))
     meta["status"] = status
-    meta["end_time"] = time.time()
+    meta["end_time"] = wallclock()
     _write_json(os.path.join(d, "meta.json"), meta)
 
 
@@ -158,7 +158,7 @@ def log_kv(exp_id: str, run_id: str, kind: str, key: str, value: Any,
         if kind == "metrics":
             hist = data.get(key, [])
             hist.append({"value": float(value), "step": step or len(hist),
-                         "timestamp": time.time()})
+                         "timestamp": wallclock()})
             data[key] = hist
         else:
             data[key] = str(value) if kind == "params" else value
@@ -212,7 +212,7 @@ def create_registered_model(name: str, description: str = "") -> Dict[str, Any]:
         if existing:
             return existing
         meta = {"name": name, "description": description,
-                "creation_timestamp": time.time(), "latest_version": 0}
+                "creation_timestamp": wallclock(), "latest_version": 0}
         os.makedirs(os.path.join(model_dir(name), "versions"), exist_ok=True)
         _write_json(os.path.join(model_dir(name), "meta.json"), meta)
         return meta
@@ -224,7 +224,7 @@ def update_registered_model(name: str, description: str) -> Dict[str, Any]:
         if meta is None:
             raise ValueError(f"registered model {name!r} not found")
         meta["description"] = description
-        meta["last_updated_timestamp"] = time.time()
+        meta["last_updated_timestamp"] = wallclock()
         _write_json(os.path.join(model_dir(name), "meta.json"), meta)
         return meta
 
@@ -235,7 +235,7 @@ def create_model_version(name: str, source: str, run_id: Optional[str] = None,
         meta = create_registered_model(name)
         v = int(meta.get("latest_version", 0)) + 1
         meta["latest_version"] = v
-        meta["last_updated_timestamp"] = time.time()
+        meta["last_updated_timestamp"] = wallclock()
         _write_json(os.path.join(model_dir(name), "meta.json"), meta)
         vd = os.path.join(model_dir(name), "versions", str(v))
         os.makedirs(vd, exist_ok=True)
@@ -244,7 +244,7 @@ def create_model_version(name: str, source: str, run_id: Optional[str] = None,
         vmeta = {"name": name, "version": v, "source": source,
                  "run_id": run_id, "current_stage": "None",
                  "status": "READY", "description": description,
-                 "creation_timestamp": time.time()}
+                 "creation_timestamp": wallclock()}
         _write_json(os.path.join(vd, "meta.json"), vmeta)
         return vmeta
 

@@ -16,11 +16,26 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
-import jax
-
 from ..conf import GLOBAL_CONF
 from ..obs import _audit as _obs_audit
 from ..obs._recorder import RECORDER as _OBS
+
+
+def now() -> float:
+    """THE engine's monotonic clock (seconds, perf_counter domain — the
+    same domain as recorder event stamps and audit walls). Every timing
+    outside this module and obs/ must use `now()` / `wallclock()` / a
+    `PROFILER.span` — enforced by the graftlint rule
+    no-wallclock-in-engine — so measurements stay correlatable with the
+    flight-recorder timeline."""
+    return time.perf_counter()
+
+
+def wallclock() -> float:
+    """THE engine's epoch clock (seconds since the Unix epoch), for
+    domain timestamps (Delta log entries, tracking runs, stream batch
+    stamps, deadlines). See `now()` for the single-clock rule."""
+    return time.time()
 
 
 @dataclass
@@ -172,6 +187,7 @@ PROFILER = Profiler()
 @contextlib.contextmanager
 def start_device_trace(logdir: str) -> Iterator[None]:
     """XLA-level trace (TensorBoard-compatible) around a block."""
+    import jax  # lazy: the profiler itself must stay importable jax-free
     jax.profiler.start_trace(logdir)
     try:
         yield

@@ -1,0 +1,117 @@
+"""CI enforcement (PR 3): the committed tree must pass graftlint, the
+linter must run jax-free from a cold interpreter, and the bench harness
+must refuse to record from a dirty tree (`bench.py --lint`)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RUNNER = os.path.join(REPO, "scripts", "graftlint.py")
+
+
+def test_graftlint_clean_and_jax_free():
+    """One subprocess proves both acceptance criteria: exit 0 on the
+    repo with >=6 active rules, and no jax import anywhere in the lint
+    path (the probe would raise before printing)."""
+    probe = (
+        "import importlib.util, json, sys\n"
+        f"spec = importlib.util.spec_from_file_location('_g', {RUNNER!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "rc = m.main(['--json'])\n"
+        "assert 'jax' not in sys.modules, 'linter imported jax'\n"
+        "assert 'sml_tpu' not in sys.modules, 'linter imported sml_tpu'\n"
+        "sys.exit(rc)\n")
+    out = subprocess.run([sys.executable, "-c", probe], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["clean"] is True
+    assert len(payload["rules"]) >= 6
+    assert payload["violations"] == []
+
+
+def test_single_rule_run_is_clean_on_committed_tree():
+    """`--rule NAME` must exit 0 on the clean tree: suppressions owned
+    by the rules that did NOT run are out of scope (review finding)."""
+    out = subprocess.run([sys.executable, RUNNER, "--rule",
+                          "conf-key-registry"], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_update_baseline_preserves_reviewed_entries(tmp_path):
+    """--update-baseline on the clean tree must re-emit the reviewed
+    timeseries entries (reasons intact), not erase them because the old
+    baseline already suppressed them (review finding)."""
+    for d in ("sml_tpu", "scripts"):
+        shutil.copytree(os.path.join(REPO, d), tmp_path / d,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+    for f in ("bench.py", ".graftlint-baseline.json"):
+        shutil.copy(os.path.join(REPO, f), tmp_path / f)
+    os.makedirs(tmp_path / "tests")
+    out = subprocess.run(
+        [sys.executable, str(tmp_path / "scripts" / "graftlint.py"),
+         "--update-baseline", "--root", str(tmp_path)],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    with open(tmp_path / ".graftlint-baseline.json") as fh:
+        entries = json.load(fh)["entries"]
+    assert len(entries) == 3, entries
+    assert all(e["file"] == "sml_tpu/timeseries.py" for e in entries)
+    assert all(not e["reason"].startswith("TODO") for e in entries)
+    # and the refreshed baseline still passes the lint
+    out2 = subprocess.run(
+        [sys.executable, str(tmp_path / "scripts" / "graftlint.py"),
+         "--root", str(tmp_path)],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120)
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+
+
+def test_graftlint_json_reports_suppressions():
+    out = subprocess.run([sys.executable, RUNNER, "--json"], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    # every carried suppression is visible in the machine output
+    assert payload["suppressed"]["baseline"] >= 1
+    assert payload["suppressed"]["pragma"] >= 1
+
+
+def test_bench_lint_gate_refuses_dirty_tree(tmp_path):
+    """Copy the lintable surface, inject a violation, and check
+    `bench.py --lint` exits 1 with the refusal message BEFORE doing any
+    bench work (bench imports only numpy at module level, so this is a
+    sub-second subprocess)."""
+    for d in ("sml_tpu", "scripts"):
+        shutil.copytree(os.path.join(REPO, d), tmp_path / d,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+    for f in ("bench.py", ".graftlint-baseline.json"):
+        shutil.copy(os.path.join(REPO, f), tmp_path / f)
+    os.makedirs(tmp_path / "tests")
+    rogue = tmp_path / "sml_tpu" / "rogue.py"
+    rogue.write_text("import time\nT0 = time.time()\n")
+    out = subprocess.run([sys.executable, "bench.py", "--lint"],
+                         cwd=tmp_path, capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "refusing to record" in out.stderr
+    assert "rogue.py" in out.stdout
+    # and the same tree passes once the violation is gone
+    rogue.unlink()
+    probe = (
+        "import importlib.util, sys\n"
+        "spec = importlib.util.spec_from_file_location('_g', "
+        "'scripts/graftlint.py')\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "sys.exit(m.main([]))\n")
+    out2 = subprocess.run([sys.executable, "-c", probe], cwd=tmp_path,
+                          capture_output=True, text=True, timeout=120)
+    assert out2.returncode == 0, out2.stdout + out2.stderr
