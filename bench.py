@@ -16,6 +16,11 @@ Legs (every BASELINE.json config):
   ML 11     XGBoost-equivalent (tpu_hist boosted trees), log-price target
   ML 12     batch inference via DeviceScorer-backed mapInPandas
   ML 13     applyInPandas per-group training
+  serving   online scoring through sml_tpu/serving: closed-loop clients
+            issuing low-row requests through the continuous micro-batcher
+            (registry-style endpoint path); p50/p99 per-request latency,
+            batch occupancy, and shed rate go to the sidecar as serve_*
+            metrics (excluded from golden pins — they are load numbers)
   MLE 01/02 block-parallel ALS (MovieLens-1M scale) + fused-Lloyd KMeans
   ml_scale  8M-row LinearRegression + LogisticRegression fits through the
             compact expand-on-device programs (prepared features on BOTH
@@ -146,6 +151,15 @@ CAT_COLS = ["neighbourhood_cleansed", "room_type", "property_type"]
 NUM_COLS = ["accommodates", "bathrooms", "bedrooms", "beds",
             "minimum_nights", "number_of_reviews", "review_scores_rating"]
 
+# serving leg: closed-loop load (SERVE_CLIENTS concurrent clients, each
+# issuing SERVE_REQUEST_ROWS-row requests back-to-back until the shared
+# budget of SERVE_REQUESTS is spent) — identical on both sides
+SERVE_CLIENTS = 8
+SERVE_REQUEST_ROWS = 8
+SERVE_REQUESTS = 2000
+SERVE_MAX_BATCH_ROWS = 256
+SERVE_FLUSH_MICROS = 1000
+
 _scale_cache: dict = {}
 
 
@@ -215,6 +229,95 @@ def run_scale_leg(timings, flops, metrics, eng=None):
     margin = head.predict_affine(res_lg.coefficients, res_lg.intercept)
     metrics["scale_accuracy"] = float(np.mean((margin > 0) == (yl[:1_000_000] > 0.5)))
     metrics["scale_d"] = float(d)
+
+
+def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
+    """Online-serving leg (docs/SERVING.md): SERVE_CLIENTS closed-loop
+    clients push SERVE_REQUEST_ROWS-row requests through the continuous
+    micro-batcher in front of a warm DeviceScorer — the amortize-one-
+    compiled-program-over-many-small-requests story, measured. Feature
+    prep happens OUTSIDE the timed region on both sides (an online
+    endpoint scores feature blocks); the timed region is admission →
+    coalesce → device dispatch → per-request split."""
+    import threading
+
+    from sml_tpu.ml import DeviceScorer
+    from sml_tpu.serving import MicroBatcher
+    from sml_tpu.utils.profiler import PROFILER, now
+
+    from sml_tpu.serving import RequestShed
+
+    scorer = DeviceScorer(lr_model)
+    X = scorer._prep(test.toPandas())[:SERVE_REQUESTS * SERVE_REQUEST_ROWS]
+    d = X.shape[1]
+    slices = [X[lo:lo + SERVE_REQUEST_ROWS]
+              for lo in range(0, len(X), SERVE_REQUEST_ROWS)]
+    # warm the padded-shape buckets the coalescer can actually produce
+    # (every multiple of the request size up to a full batch maps onto
+    # bucket_rows' coarse grid — a handful of distinct shapes), so the
+    # timed region measures serving, not first-seen-shape compiles; real
+    # compile economics are the suite's warmup passes' job
+    from sml_tpu.parallel.dispatch import bucket_rows
+    warm = sorted({bucket_rows(r, 1) for r in
+                   range(SERVE_REQUEST_ROWS,
+                         SERVE_MAX_BATCH_ROWS + 1, SERVE_REQUEST_ROWS)})
+    for rows in warm:
+        scorer.score_block(np.ascontiguousarray(X[:rows]))
+    c0 = PROFILER.counters()
+    lat = [[] for _ in range(SERVE_CLIENTS)]
+    next_req = [0]
+    req_lock = threading.Lock()
+
+    def client(ci, batcher):
+        while True:
+            with req_lock:
+                i = next_req[0]
+                if i >= len(slices):
+                    return
+                next_req[0] = i + 1
+            t0 = now()
+            try:
+                batcher.submit(slices[i]).result(timeout=60)
+            except RequestShed:
+                continue  # shed is an answer, not a client crash — the
+                # shed rate is reported from the serve.shed counter
+            lat[ci].append(now() - t0)
+
+    t0 = time.perf_counter()
+    with MicroBatcher(scorer.score_block,
+                      host_score=scorer.score_block_host,
+                      max_batch_rows=SERVE_MAX_BATCH_ROWS,
+                      flush_micros=SERVE_FLUSH_MICROS) as batcher:
+        threads = [threading.Thread(target=client, args=(ci, batcher))
+                   for ci in range(SERVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    timings["serving"] = time.perf_counter() - t0
+    if eng is not None:
+        eng.mark("serving")
+    flops["serving"] = 2.0 * len(X) * d
+    c1 = PROFILER.counters()
+
+    def delta(k):
+        return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+    all_lat = sorted(t for ls in lat for t in ls)
+    batches = max(delta("serve.batches"), 1.0)
+    reqs = max(delta("serve.requests"), 1.0)
+    metrics["serve_p50_ms"] = round(
+        1e3 * all_lat[len(all_lat) // 2], 3) if all_lat else 0.0
+    metrics["serve_p99_ms"] = round(
+        1e3 * all_lat[min(int(len(all_lat) * 0.99), len(all_lat) - 1)],
+        3) if all_lat else 0.0
+    # numerator = rows that actually entered a device batch (serve.rows
+    # also counts shed/host-routed admissions, which would inflate this
+    # exactly when the degradation ladder is active)
+    metrics["serve_occupancy"] = round(
+        delta("serve.batch_rows") / (batches * SERVE_MAX_BATCH_ROWS), 4)
+    metrics["serve_shed_rate"] = round(delta("serve.shed") / reqs, 4)
+    metrics["serve_host_routed"] = delta("serve.host_routed")
 
 
 def run_electives(ratings_df, train, timings, flops, eng=None):
@@ -409,6 +512,10 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
     _CONF.set("spark.sql.execution.arrow.maxRecordsPerBatch", _old_bs)
     flops["ml12_mapinpandas"] = 2.0 * n_scored * d_lr
 
+    # ---- serving: closed-loop online micro-batched scoring --------------
+    serve_metrics = {}
+    run_serving_leg(lr_model, test, timings, flops, serve_metrics, eng)
+
     # ---- ML 13: per-group training fan-out ------------------------------
     t0 = time.perf_counter()
 
@@ -435,6 +542,7 @@ def run_suite(df, n_rows, ratings_df=None, with_scale=True):
     metrics = {"rmse_lr": rmse_lr, "rmse_dt": rmse_dt, "rmse_rf": rmse_rf,
                "rmse_xgb": rmse_xgb, "cv_best_rmse": cv_best,
                "rows_scored": n_scored, "groups": n_groups}
+    metrics.update(serve_metrics)
     if ratings_df is not None:
         metrics.update(run_electives(ratings_df, train, timings, flops, eng))
     if with_scale:
@@ -510,7 +618,7 @@ def run_host_baseline(pdf, ratings_pdf=None, only=None):
         return pd.concat([X, frame[NUM_COLS]], axis=1).to_numpy(np.float64)
 
     m = None
-    if want("ml02_lr") or want("ml12_mapinpandas"):
+    if want("ml02_lr") or want("ml12_mapinpandas") or want("serving"):
         t0 = time.perf_counter()
         Xtr, Xte = featurize(train, True), featurize(test, True)
         m = SkLR().fit(Xtr, train["price"])
@@ -583,6 +691,16 @@ def run_host_baseline(pdf, ratings_pdf=None, only=None):
                  for lo in range(0, len(test), bs)]
         np.concatenate(preds)
         timings["ml12_mapinpandas"] = time.perf_counter() - t0
+
+    if want("serving"):
+        # the no-batching anchor: the SAME closed-loop request stream
+        # scored one request at a time (sklearn predict per request) —
+        # what an endpoint without coalescing pays
+        Xs = featurize(test, True)[:SERVE_REQUESTS * SERVE_REQUEST_ROWS]
+        t0 = time.perf_counter()
+        for lo in range(0, len(Xs), SERVE_REQUEST_ROWS):
+            m.predict(Xs[lo:lo + SERVE_REQUEST_ROWS])
+        timings["serving"] = time.perf_counter() - t0
 
     if want("ml13_applyinpandas"):
         # the framework leg groups the RAW train frame (NaNs intact, so the
@@ -789,8 +907,11 @@ def pin_goldens():
         "note": "suite metrics pinned on the virtual 8-device CPU mesh "
                 "(f32); the TPU bench asserts its metrics within "
                 "GOLDEN_TOLERANCES of these",
+        # serve_* metrics are LOAD numbers (latency/occupancy under this
+        # machine's contention), not model outputs — never pinned
         "metrics": {k: (round(float(v), 6) if isinstance(v, float)
-                        else v) for k, v in metrics.items()},
+                        else v) for k, v in metrics.items()
+                    if not k.startswith("serve_")},
     }
     with open(GOLDEN_FILE, "w") as f:
         json.dump(golden, f, indent=1)
@@ -867,8 +988,12 @@ def main():
     # session — r4's fairness gap), best of HOST_TIMED_PASSES to match the
     # device legs' best-of-3 discipline; expensive legs keep the cached
     # anchor
+    # a leg MISSING from the committed cache (e.g. a newly added leg) is
+    # treated as cheap and measured fresh this run, so adding a leg does
+    # not force a LEGS_VERSION bump (= a full multi-minute re-measure of
+    # the expensive cached legs)
     thin = [k for k in leg_secs
-            if base.get(k, float("inf")) < HOST_REMEASURE_CUTOFF_S]
+            if base.get(k, 0.0) < HOST_REMEASURE_CUTOFF_S]
     print(f"re-measuring host baseline for cheap legs "
           f"(best of {HOST_TIMED_PASSES}): {thin}", file=sys.stderr)
     host_passes = [run_host_baseline(pdf, ratings_pdf, only=set(thin))

@@ -129,6 +129,11 @@ _register("sml.training.module-name", "", str,
           "(courseware.CourseConfig)")
 _register("sml.training.username", "", str,
           "Course username stamped by the Classroom-Setup shim")
+_register("sml.infer.prefetchBatches", 4, int,
+          "DeviceScorer.score_batches lookahead: batches dispatched ahead "
+          "of the drain point so batch i+1's prep + H2D staging overlaps "
+          "batch i's compute and D2H (was a hard-coded 4). 1 = fully "
+          "synchronous")
 _register("sml.cv.batchFolds", False, _to_bool,
           "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
           "map into one vmapped device program for tree regressors. "

@@ -289,15 +289,7 @@ class DeviceScorer:
             return out, n, lambda m: m
 
         spec, sf, sb, lv, w = self._params
-
-        def finalize(margin):
-            margin = spec.base + margin
-            if spec.mode == "binary":
-                # boosted margins → sigmoid; probability-leaf forests → clip
-                if spec.tree_weights is not None:
-                    return 1.0 / (1.0 + np.exp(-margin))
-                return np.clip(margin, 0.0, 1.0)
-            return margin
+        finalize = self._finalize_forest
 
         from .tree_impl import bin_with, predict_forest
         binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
@@ -319,6 +311,53 @@ class DeviceScorer:
                    jnp.asarray(lv, dtype=jnp.float32),
                    jnp.asarray(w, dtype=jnp.float32))
         return out, n, finalize
+
+    def _finalize_forest(self, margin: np.ndarray) -> np.ndarray:
+        """Margin → prediction for the tree-ensemble kinds: boosted margins
+        go through the sigmoid, probability-leaf forests clip."""
+        spec = self._params[0]
+        margin = spec.base + margin
+        if spec.mode == "binary":
+            if spec.tree_weights is not None:
+                return 1.0 / (1.0 + np.exp(-margin))
+            return np.clip(margin, 0.0, 1.0)
+        return margin
+
+    def score_block_host(self, X: np.ndarray) -> np.ndarray:
+        """Predict a raw (n, d) feature block on the HOST route
+        unconditionally — the serving layer's degradation target when the
+        device queue saturates (admission control falls back here instead
+        of deadlocking behind a full micro-batch queue). Same numerics as
+        `score_block`'s host branch; never stages, never dispatches."""
+        from ..parallel import dispatch as _dispatch_mod
+        if self._kind == "linear":
+            w, b, logistic = self._params
+            out = np.asarray(X, np.float64) @ np.asarray(w, np.float64) + b
+            if logistic:
+                out = 1.0 / (1.0 + np.exp(-out))
+            return out
+        spec = self._params[0]
+        from .tree_impl import bin_with, predict_forest
+        binned = bin_with(np.asarray(X, dtype=np.float64), spec.binning)
+        import jax as _jax
+        host_dev = list(_dispatch_mod.host_mesh().devices.flat)[0]
+        flops = 4.0 * binned.shape[0] * len(spec.trees) * spec.depth
+        with _dispatch_mod.observe_host("traverse", flops), \
+                _jax.default_device(host_dev):
+            margin = predict_forest(binned, spec.trees, spec.depth,
+                                    spec.tree_weights)
+        return self._finalize_forest(margin)
+
+    def resident_bytes(self) -> int:
+        """Approximate bytes a WARM scorer pins per mesh (model tensors
+        replicated into HBM plus their host mirrors) — the cost model the
+        serving multi-model cache budgets against. Feature-prep state is
+        negligible next to the model tensors and is not counted."""
+        if self._kind == "linear":
+            arrays = [self._params[0]]
+        else:
+            arrays = [a for a in self._params[1:] if a is not None]
+        return max(int(sum(np.asarray(a).nbytes for a in arrays)), 64)
 
     def _build_factorized(self):
         """(scalar_sources, scalar_weights, embeds): weight slices aligned
@@ -430,21 +469,33 @@ class DeviceScorer:
         return extract_features(cur, self.featuresCol)
 
     def score_batches(self, batches: Iterable,
-                      depth: int = 4) -> Iterator[np.ndarray]:
+                      depth: Optional[int] = None) -> Iterator[np.ndarray]:
         """Pipeline an iterator of pandas batches through the scorer:
         feature prep for upcoming batches runs on worker threads (pandas /
         numpy release the GIL in their C paths) while the current batch's
         math executes, and on the device route up to `depth` batches are
         dispatched ahead with async host copies started at dispatch — prep,
-        H2D staging, device compute, and D2H transfers all overlap."""
+        H2D staging, device compute, and D2H transfers all overlap.
+
+        `depth` defaults to `sml.infer.prefetchBatches` (conf). With the
+        flight recorder on, every dispatch and drain emits an `infer.*`
+        event, so the staging-of-batch-i+1-overlaps-compute-of-batch-i
+        pipelining claim is ASSERTABLE from the event order (batch i+1's
+        dispatch lands before batch i's drain — tested)."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
+
+        from ..conf import GLOBAL_CONF
+        from ..obs._recorder import RECORDER as _OBS
+        if depth is None:
+            depth = max(GLOBAL_CONF.getInt("sml.infer.prefetchBatches"), 1)
         if self._factorized is not None:
             # factorized linear scoring is pure host numpy/pandas work:
             # overlap batches on worker threads with BOUNDED lookahead —
-            # Executor.map would drain the whole source iterator eagerly
+            # Executor.map would drain the whole source iterator eagerly.
+            # The window IS the prefetch depth (depth=1 → synchronous)
             it = iter(batches)
-            with ThreadPoolExecutor(max_workers=4) as ex:
+            with ThreadPoolExecutor(max_workers=min(depth, 4)) as ex:
                 window: deque = deque()
 
                 def pull() -> bool:
@@ -455,7 +506,7 @@ class DeviceScorer:
                     window.append(ex.submit(self.__call__, b))
                     return True
 
-                for _ in range(4):
+                for _ in range(depth):
                     pull()
                 while window:
                     out = window.popleft().result()
@@ -465,8 +516,11 @@ class DeviceScorer:
         pending: deque = deque()
 
         def drain_one():
-            out, n, fin = pending.popleft()
-            return fin(np.asarray(out, dtype=np.float64)[:n])
+            i, out, n, fin = pending.popleft()
+            res = fin(np.asarray(out, dtype=np.float64)[:n])
+            if _OBS.enabled:
+                _OBS.emit("infer", "infer.drain", args={"batch": i})
+            return res
 
         workers = 4
         with ThreadPoolExecutor(max_workers=workers) as ex:
@@ -483,15 +537,20 @@ class DeviceScorer:
 
             for _ in range(workers):
                 submit_next()
+            dispatched = 0
             while preps:
                 X = preps.popleft().result()
                 submit_next()
                 out, n, fin = self._dispatch(X)
+                if _OBS.enabled:
+                    _OBS.emit("infer", "infer.dispatch",
+                              args={"batch": dispatched})
                 try:
                     out.copy_to_host_async()
                 except Exception:
                     pass
-                pending.append((out, n, fin))
+                pending.append((dispatched, out, n, fin))
+                dispatched += 1
                 if len(pending) >= depth:
                     yield drain_one()
             while pending:

@@ -68,7 +68,8 @@ def to_trace_events(events: List[Event]) -> List[dict]:
             out.append({"ph": "C", "pid": PID_HOST, "tid": 0, "ts": ts_us,
                         "name": ev.name, "cat": "counter",
                         "args": {"value": ev.args.get("total", 0.0)}})
-        elif ev.kind in ("dispatch", "cache", "collective", "compile"):
+        elif ev.kind in ("dispatch", "cache", "collective", "compile",
+                         "serve", "infer"):
             # instant markers: visible pins on the timeline without lanes
             out.append({"ph": "i", "s": "t", "pid": PID_HOST,
                         "tid": ev.tid, "ts": ts_us, "name": ev.name,

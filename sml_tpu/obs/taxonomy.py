@@ -27,6 +27,8 @@ SPANS = {
     # ML engine
     "fused_transform", "binning.predict",
     "program.*",          # program.<fn> / program.tree_ensemble / ...
+    # serving layer: one coalesced device dispatch of the micro-batcher
+    "serve.batch",
 }
 
 COUNTERS = {
@@ -39,10 +41,20 @@ COUNTERS = {
     "compile.programs",
     "dispatch.route_*",   # dispatch.route_host / dispatch.route_device
     "collective.*",       # per-trace collective launch counts
+    # serving layer (sml_tpu/serving): request admission, micro-batch
+    # dispatches, degradation ladder, model cache, canary mirror
+    "serve.requests", "serve.rows",
+    "serve.batches", "serve.batch_rows", "serve.batch_pad_rows",
+    "serve.shed", "serve.expired", "serve.host_routed",
+    "serve.hot_swap",
+    "serve.model_cache_hit", "serve.model_cache_miss",
+    "serve.model_cache_evict_bytes",
+    "serve.canary_mirrored",
 }
 
 GAUGES = {
     "hbm.*",              # hbm.<pool>_bytes / hbm.total_bytes
+    "serve.queue_rows",   # rows admitted but not yet dispatched
 }
 
 EVENTS = {
@@ -50,6 +62,8 @@ EVENTS = {
     "cache.*",            # cache.evict / ...
     "collective.*",       # collective.psum / ...
     "compile.*",          # compile.trace / compile.cache_dir
+    "serve.*",            # serve.swap (endpoint hot-swap receipts)
+    "infer.*",            # infer.dispatch / infer.drain (batch pipelining)
 }
 
 _BY_KIND = {"span": SPANS, "count": COUNTERS, "counter": COUNTERS,

@@ -209,6 +209,38 @@ class _ObservedRates:
 OBSERVED_HOST = _ObservedRates()
 
 
+class _QueuePressure:
+    """Rows currently queued for (or in flight on) the device by online
+    serving — the dispatcher's backpressure signal. The serving
+    micro-batcher feeds it (`add` at admission, `sub` when a batch
+    completes or sheds); admission control reads `rows()` to decide when
+    the device lane is saturated and traffic should degrade to the host
+    route instead of queueing behind it. Deliberately NOT a term in
+    `device_time` — fits price a single dispatch, while serving pressure
+    is a property of the standing queue, and mixing the two would let a
+    transient burst reroute long training jobs."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rows = 0
+
+    def add(self, rows: int) -> None:
+        with self._lock:
+            self._rows += int(rows)
+
+    def sub(self, rows: int) -> None:
+        with self._lock:
+            self._rows = max(0, self._rows - int(rows))
+
+    def rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+
+#: process-wide device-queue pressure (one device tunnel per process)
+DEVICE_QUEUE = _QueuePressure()
+
+
 import contextlib as _contextlib
 
 

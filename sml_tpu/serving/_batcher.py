@@ -1,0 +1,302 @@
+"""Continuous micro-batching: many small requests, one device dispatch.
+
+A tunneled chip charges a FIXED dispatch+readback latency per program
+launch; serving 1-row requests one launch at a time caps throughput at
+`1/rt_fixed` regardless of the math. The fix is the classic serving
+shape (Arrow batch tuning in `ML 12`, the XGBoost-GPU amortization
+story): admit requests into a bounded queue, coalesce everything queued
+into one padded, shape-bucketed block, run the SAME cached jitted
+program (`DeviceScorer.score_block` pads onto `bucket_rows`'s grid, so
+every batch of a size class hits one compiled signature), and split the
+result back per request.
+
+Flush policy — whichever comes first:
+- rows: a full batch (`sml.serve.maxBatchRows`) flushes immediately;
+- deadline: the OLDEST queued request has waited `sml.serve.flushMicros`
+  (a lone request never waits longer than the flush window).
+
+Degradation ladder (admission → flush):
+1. queue has room → enqueue (rows also feed
+   `parallel.dispatch.DEVICE_QUEUE`, the dispatcher's pressure signal);
+2. queue saturated (`sml.serve.queueRows`) → score synchronously on the
+   HOST route in the caller's thread (`sml.serve.hostFallback`) — the
+   caller pays its own overflow, which is exactly backpressure;
+3. host fallback disabled → shed (`RequestShed`) instead of deadlocking;
+4. at flush time, queued requests past `sml.serve.requestTimeoutMillis`
+   shed — a deadline the caller already gave up on is not worth a
+   device dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+from ..obs._recorder import RECORDER as _OBS
+from ..parallel import dispatch
+from ..utils.profiler import PROFILER, now
+
+
+class RequestShed(RuntimeError):
+    """The admission controller refused (queue full, no host fallback) or
+    the request's deadline passed before its batch flushed."""
+
+
+class ScoreFuture:
+    """Handle for one submitted request: `result()` blocks for the
+    per-request prediction slice (or raises what the batch raised)."""
+
+    def __init__(self, n_rows: int):
+        self._event = threading.Event()
+        self._n_rows = n_rows
+        self._value: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still queued/in flight")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _set(self, value: np.ndarray) -> None:
+        self._value = value
+        self._event.set()
+
+    def _set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+
+class _Pending:
+    __slots__ = ("X", "n", "future", "t_enqueue", "deadline")
+
+    def __init__(self, X: np.ndarray, deadline: Optional[float]):
+        self.X = X
+        self.n = int(X.shape[0])
+        self.future = ScoreFuture(self.n)
+        self.t_enqueue = now()
+        self.deadline = deadline
+
+
+class MicroBatcher:
+    """Coalesce concurrent `submit(X)` calls into device batches scored
+    by `score_block` (any callable with `DeviceScorer.score_block`'s
+    contract). `host_score` is the synchronous overflow route
+    (`DeviceScorer.score_block_host`); None disables host fallback
+    regardless of conf.
+
+    `start=False` leaves the flush worker paused (`start()` arms it) —
+    tests use this to stage a deterministic queue before the first
+    flush."""
+
+    def __init__(self, score_block: Callable[[np.ndarray], np.ndarray], *,
+                 host_score: Optional[Callable] = None,
+                 max_batch_rows: Optional[int] = None,
+                 flush_micros: Optional[int] = None,
+                 queue_rows: Optional[int] = None,
+                 timeout_millis: Optional[int] = None,
+                 host_fallback: Optional[bool] = None,
+                 start: bool = True):
+        self._score_block = score_block
+        self._host_score = host_score
+        conf = GLOBAL_CONF
+        self.max_batch_rows = max(int(
+            conf.getInt("sml.serve.maxBatchRows")
+            if max_batch_rows is None else max_batch_rows), 1)
+        micros = (conf.getInt("sml.serve.flushMicros")
+                  if flush_micros is None else flush_micros)
+        self._flush_s = max(int(micros), 0) / 1e6
+        self.queue_rows = max(int(
+            conf.getInt("sml.serve.queueRows")
+            if queue_rows is None else queue_rows), 1)
+        millis = (conf.getInt("sml.serve.requestTimeoutMillis")
+                  if timeout_millis is None else timeout_millis)
+        self._timeout_s = max(int(millis), 0) / 1e3 or None
+        self._host_fallback = (conf.getBool("sml.serve.hostFallback")
+                               if host_fallback is None else
+                               bool(host_fallback)) \
+            and host_score is not None
+        self._cond = threading.Condition()
+        self._q: deque = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        """Arm the flush worker (idempotent)."""
+        with self._cond:
+            if self._thread is not None or self._closed:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="sml-serve-batcher", daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        """Drain the queue (remaining requests still score) and stop."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        # a never-started batcher still owes its queued callers an answer
+        batch = self._take_batch()
+        while batch:
+            self._run_batch(batch)
+            batch = self._take_batch()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, X: np.ndarray) -> ScoreFuture:
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        n = int(X.shape[0])
+        PROFILER.count("serve.requests")
+        PROFILER.count("serve.rows", float(n))
+        deadline = (now() + self._timeout_s) if self._timeout_s else None
+        pending = _Pending(X, deadline)
+        with self._cond:
+            saturated = self._closed or \
+                dispatch.DEVICE_QUEUE.rows() + n > self.queue_rows
+            if not saturated:
+                dispatch.DEVICE_QUEUE.add(n)
+                self._q.append(pending)
+                self._queued_rows += n
+                queued = self._queued_rows
+                self._cond.notify()
+        if saturated:
+            return self._overflow(pending)
+        if _OBS.enabled:
+            _OBS.gauge("serve.queue_rows", float(queued))
+        return pending.future
+
+    def _overflow(self, pending: _Pending) -> ScoreFuture:
+        """Degradation ladder past admission: host route, else shed."""
+        if self._host_fallback:
+            PROFILER.count("serve.host_routed")
+            try:
+                pending.future._set(np.asarray(
+                    self._host_score(pending.X), dtype=np.float64))
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                pending.future._set_error(e)
+            return pending.future
+        PROFILER.count("serve.shed")
+        pending.future._set_error(RequestShed(
+            f"serving queue saturated ({dispatch.DEVICE_QUEUE.rows()} rows "
+            f"queued toward the device, bound {self.queue_rows}) and host "
+            f"fallback is off"))
+        return pending.future
+
+    # ---------------------------------------------------------------- flush
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def _rows_for_width(self, width: int) -> int:
+        return sum(p.n for p in self._q if p.X.shape[1] == width)
+
+    def _take_batch(self) -> List[_Pending]:
+        """Pop one shape-bucket batch (FIFO within the oldest request's
+        feature width, up to max_batch_rows; a single over-wide request
+        still forms its own batch). Requests of other widths keep their
+        queue position."""
+        with self._cond:
+            if not self._q:
+                return []
+            width = self._q[0].X.shape[1]
+            batch: List[_Pending] = []
+            rows = 0
+            rest: deque = deque()
+            while self._q:
+                p = self._q.popleft()
+                if p.X.shape[1] != width or \
+                        (batch and rows + p.n > self.max_batch_rows):
+                    rest.append(p)
+                    continue
+                batch.append(p)
+                rows += p.n
+                if rows >= self.max_batch_rows:
+                    break
+            while self._q:
+                rest.append(self._q.popleft())
+            self._q = rest
+            self._queued_rows -= rows
+            queued = self._queued_rows
+        if _OBS.enabled:
+            _OBS.gauge("serve.queue_rows", float(queued))
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait(0.05)
+                if self._closed and not self._q:
+                    return
+                first = self._q[0]
+                flush_at = first.t_enqueue + self._flush_s
+                width = first.X.shape[1]
+                while (not self._closed
+                       and self._rows_for_width(width) < self.max_batch_rows
+                       and now() < flush_at):
+                    self._cond.wait(max(flush_at - now(), 1e-4))
+            batch = self._take_batch()
+            if batch:
+                self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        t = now()
+        live: List[_Pending] = []
+        for p in batch:
+            if p.deadline is not None and t > p.deadline:
+                PROFILER.count("serve.expired")
+                PROFILER.count("serve.shed")
+                dispatch.DEVICE_QUEUE.sub(p.n)
+                p.future._set_error(RequestShed(
+                    "request exceeded sml.serve.requestTimeoutMillis "
+                    "before its batch flushed"))
+                continue
+            live.append(p)
+        if not live:
+            return
+        total = sum(p.n for p in live)
+        X = live[0].X if len(live) == 1 else \
+            np.concatenate([p.X for p in live], axis=0)
+        # the shape-grid pad the staged block will carry (bucket_rows's
+        # coarse grid; the mesh may round further for per-chip equality)
+        pad = dispatch.bucket_rows(total, 1) - total
+        try:
+            with PROFILER.span("serve.batch", rows=total,
+                               requests=len(live)):
+                out = np.asarray(self._score_block(X), dtype=np.float64)
+            PROFILER.count("serve.batches")
+            # rows that actually entered a device batch — the occupancy
+            # numerator (serve.rows also counts shed/host-routed admissions)
+            PROFILER.count("serve.batch_rows", float(total))
+            if pad > 0:
+                PROFILER.count("serve.batch_pad_rows", float(pad))
+            lo = 0
+            for p in live:
+                p.future._set(out[lo:lo + p.n])
+                lo += p.n
+        except BaseException as e:  # noqa: BLE001 — futures carry it
+            for p in live:
+                p.future._set_error(e)
+        finally:
+            dispatch.DEVICE_QUEUE.sub(total)

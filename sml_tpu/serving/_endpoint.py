@@ -1,0 +1,232 @@
+"""Registry-backed serving endpoint: stage aliases, hot-swap, canary.
+
+`ServingEndpoint("model", "Production")` is the engine-side shape of the
+course's registry-staged REST scorer (`ML 05`'s stage transitions feeding
+the real-time-deployment elective): the endpoint binds a NAME + STAGE
+ALIAS, not a version. Resolution goes through
+`tracking._store.resolve_stage`; the store's `on_stage_transition` hook
+fires on every `transition_model_version_stage` commit, so a promotion
+hot-swaps the serving scorer in-process — in-flight batches finish on the
+old version, the next batch scores on the new one, and nothing polls.
+
+Warm scorers come from the multi-model `ModelCache` (compile once, serve
+many); requests ride the `MicroBatcher` (coalescing + admission control +
+host-route degradation). Canary mode (`sml.serve.canaryFraction` > 0)
+mirrors a deterministic fraction of traffic to the Staging version OFF
+the request path (host route, one shadow worker) and accumulates
+prediction-divergence stats — the promote-with-confidence loop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+from ..obs._recorder import RECORDER as _OBS
+from ..tracking import _store
+from ..utils.profiler import PROFILER
+from ._batcher import MicroBatcher, ScoreFuture
+from ._cache import MODEL_CACHE, ModelCache
+
+
+def _load_scorer(name: str, version) -> object:
+    """DeviceScorer over a registry version's native (spark-flavor) model
+    payload — the load the cache amortizes."""
+    from ..ml.base import Saveable
+    from ..ml.inference import DeviceScorer
+    native = os.path.join(_store.model_dir(name), "versions", str(version),
+                          "model", "native")
+    if not os.path.isdir(native):
+        raise ValueError(
+            f"registered model {name!r} version {version} has no native "
+            f"model payload (log it with tracking.spark.log_model)")
+    return DeviceScorer(Saveable.load(native))
+
+
+class ServingEndpoint:
+    """Online scorer for `models:/<name>/<stage>`.
+
+    `score(X)` blocks for the prediction; `submit(X)` returns a
+    `ScoreFuture` (the closed-loop client shape). Batcher knobs
+    (`max_batch_rows`, `flush_micros`, `queue_rows`, `timeout_millis`,
+    `host_fallback`, `start`) pass through to `MicroBatcher`; defaults
+    come from the `sml.serve.*` conf keys."""
+
+    def __init__(self, name: str, stage: str = "Production", *,
+                 model_cache: Optional[ModelCache] = None,
+                 auto_update: bool = True,
+                 canary_fraction: Optional[float] = None,
+                 **batcher_kwargs):
+        self._name = name
+        self._stage = stage
+        self._cache = model_cache or MODEL_CACHE
+        self._swap_lock = threading.RLock()
+        self._scorer = None
+        self._version: Optional[int] = None
+        self._staging_scorer = None
+        self._staging_version: Optional[int] = None
+        self._canary_fraction = canary_fraction
+        self._canary_lock = threading.Lock()
+        self._canary_acc = 0.0
+        self._shadow_inflight = 0
+        self._canary = {"mirrored": 0, "rows": 0, "sum_abs_diff": 0.0,
+                        "max_abs_diff": 0.0}
+        self._shadow_pool: Optional[ThreadPoolExecutor] = None
+        self._closed = False
+        self._refresh(initial=True)
+        self._listener = self._on_transition if auto_update else None
+        if self._listener is not None:
+            _store.on_stage_transition(self._listener)
+        self._batcher = MicroBatcher(self._score_device,
+                                     host_score=self._score_host,
+                                     **batcher_kwargs)
+
+    # ----------------------------------------------------------- resolution
+    def _refresh(self, initial: bool = False) -> None:
+        """Re-resolve the stage alias (and the Staging canary target) and
+        swap the warm scorer if the resolved version changed."""
+        meta = _store.resolve_stage(self._name, self._stage)
+        if meta is None:
+            if initial:
+                raise ValueError(
+                    f"no READY version of {self._name!r} holds stage "
+                    f"{self._stage!r} — promote one with "
+                    f"transition_model_version_stage first")
+            return  # keep serving the last good version (alias emptied)
+        version = meta["version"]
+        with self._swap_lock:
+            if version != self._version:
+                self._scorer = self._cache.get(
+                    self._name, version,
+                    lambda: _load_scorer(self._name, version))
+                old, self._version = self._version, version
+                if not initial:
+                    PROFILER.count("serve.hot_swap")
+                    if _OBS.enabled:
+                        _OBS.emit("serve", "serve.swap", args={
+                            "name": self._name, "stage": self._stage,
+                            "from": old, "to": version})
+        if self._stage != "Staging":
+            smeta = _store.resolve_stage(self._name, "Staging")
+            with self._swap_lock:
+                if smeta is None:
+                    self._staging_scorer = self._staging_version = None
+                elif smeta["version"] != self._staging_version:
+                    v = smeta["version"]
+                    self._staging_scorer = self._cache.get(
+                        self._name, v, lambda: _load_scorer(self._name, v))
+                    self._staging_version = v
+
+    def _on_transition(self, name, version, stage, archived) -> None:
+        if name != self._name or self._closed:
+            return
+        self._refresh()
+        # an archived version holds no stage: no endpoint resolves to it
+        # anymore, so its warm scorer must not sit in the cache until LRU
+        # pressure happens to evict it
+        for v in archived:
+            self._cache.invalidate(self._name, v)
+
+    def current_version(self) -> Optional[int]:
+        return self._version
+
+    # -------------------------------------------------------------- scoring
+    def _score_device(self, X: np.ndarray) -> np.ndarray:
+        return self._scorer.score_block(X)
+
+    def _score_host(self, X: np.ndarray) -> np.ndarray:
+        return self._scorer.score_block_host(X)
+
+    def submit(self, X: np.ndarray) -> ScoreFuture:
+        fut = self._batcher.submit(X)
+        f = self._canary_fraction
+        if f is None:
+            f = float(GLOBAL_CONF.get("sml.serve.canaryFraction"))
+        if f > 0.0 and self._staging_scorer is not None:
+            with self._canary_lock:
+                self._canary_acc += min(f, 1.0)
+                mirror = self._canary_acc >= 1.0
+                if mirror:
+                    self._canary_acc -= 1.0
+            if mirror:
+                self._shadow(np.asarray(X), fut)
+        return fut
+
+    def score(self, X: np.ndarray,
+              timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(X).result(timeout)
+
+    # --------------------------------------------------------------- canary
+    _SHADOW_MAX_INFLIGHT = 8  # beyond this the shadow sheds, never queues
+
+    def _shadow(self, X: np.ndarray, fut: ScoreFuture) -> None:
+        with self._canary_lock:
+            # bounded mirror backlog: the shadow is best-effort sampling —
+            # when the single host-route worker falls behind the arrival
+            # rate, DROP the mirror (each queued entry would pin a copy of
+            # X until scored; an unbounded backlog is a slow OOM)
+            if self._shadow_inflight >= self._SHADOW_MAX_INFLIGHT:
+                return
+            self._shadow_inflight += 1
+            if self._shadow_pool is None:
+                self._shadow_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="sml-serve-shadow")
+            pool = self._shadow_pool
+        pool.submit(self._mirror, X, fut)
+
+    def _mirror(self, X: np.ndarray, fut: ScoreFuture) -> None:
+        """Score the mirrored request on the Staging version's HOST route
+        (the shadow must not contend for the production device queue) and
+        fold the divergence into the canary stats. Never raises into the
+        serving path."""
+        try:
+            primary = np.asarray(fut.result(timeout=60.0), dtype=np.float64)
+            scorer = self._staging_scorer
+            if scorer is None:
+                return
+            shadow = np.asarray(scorer.score_block_host(X),
+                                dtype=np.float64)
+            diff = np.abs(shadow - primary)
+            PROFILER.count("serve.canary_mirrored")
+            with self._canary_lock:
+                self._canary["mirrored"] += 1
+                self._canary["rows"] += int(diff.size)
+                self._canary["sum_abs_diff"] += float(diff.sum())
+                self._canary["max_abs_diff"] = max(
+                    self._canary["max_abs_diff"], float(diff.max()))
+        except BaseException:  # noqa: BLE001 — shadow must never serve 500s
+            pass
+        finally:
+            with self._canary_lock:
+                self._shadow_inflight -= 1
+
+    def canary_stats(self) -> Dict[str, float]:
+        with self._canary_lock:
+            out = dict(self._canary)
+        out["staging_version"] = self._staging_version
+        out["mean_abs_diff"] = (out["sum_abs_diff"] / out["rows"]
+                                if out["rows"] else 0.0)
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+        if self._listener is not None:
+            _store.remove_stage_listener(self._listener)
+            self._listener = None
+        self._batcher.close()
+        with self._canary_lock:
+            pool, self._shadow_pool = self._shadow_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def __enter__(self) -> "ServingEndpoint":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

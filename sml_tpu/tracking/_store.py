@@ -267,23 +267,74 @@ def list_model_versions(name: str) -> List[Dict[str, Any]]:
     return out
 
 
+# Stage-transition listeners: the serving layer subscribes so an endpoint
+# bound to `models:/<name>/<stage>` hot-swaps the moment a promotion lands
+# instead of polling the registry. Fired OUTSIDE the store lock (listeners
+# re-read the store; an endpoint swap may block briefly on an in-flight
+# batch) with (name, version, stage, archived_versions).
+_stage_listeners: List[Any] = []
+
+
+def on_stage_transition(fn) -> None:
+    """Register `fn(name, version, stage, archived_versions)` to fire after
+    every `set_version_stage` commit. Idempotent per function object."""
+    with _lock:
+        if fn not in _stage_listeners:
+            _stage_listeners.append(fn)
+
+
+def remove_stage_listener(fn) -> None:
+    with _lock:
+        try:
+            _stage_listeners.remove(fn)
+        except ValueError:
+            pass
+
+
 def set_version_stage(name: str, version, stage: str,
                       archive_existing_versions: bool = False) -> Dict[str, Any]:
+    """Move a version to `stage`. With `archive_existing_versions=True`
+    (MLflow's promote semantics) every OTHER version currently holding the
+    target stage moves to "Archived" in the same locked commit, so readers
+    never observe two Production holders. The target version is validated
+    BEFORE anything is archived — a bad version id must not half-apply the
+    transition (the pre-fix order archived the incumbents and then raised,
+    leaving the stage empty)."""
+    archived: List[Any] = []
     with _lock:
-        if archive_existing_versions:
-            for other in list_model_versions(name):
-                if other["current_stage"] == stage and str(other["version"]) != str(version):
-                    other["current_stage"] = "Archived"
-                    vd = os.path.join(model_dir(name), "versions",
-                                      str(other["version"]))
-                    _write_json(os.path.join(vd, "meta.json"), other)
         vd = os.path.join(model_dir(name), "versions", str(version))
         meta = _read_json(os.path.join(vd, "meta.json"))
         if not meta:
             raise ValueError(f"model version {name}/{version} not found")
+        if archive_existing_versions:
+            for other in list_model_versions(name):
+                if other["current_stage"] == stage and \
+                        str(other["version"]) != str(version):
+                    other["current_stage"] = "Archived"
+                    other["last_transition_timestamp"] = wallclock()
+                    od = os.path.join(model_dir(name), "versions",
+                                      str(other["version"]))
+                    _write_json(os.path.join(od, "meta.json"), other)
+                    archived.append(other["version"])
         meta["current_stage"] = stage
+        meta["last_transition_timestamp"] = wallclock()
         _write_json(os.path.join(vd, "meta.json"), meta)
-        return meta
+        listeners = list(_stage_listeners)
+    for fn in listeners:  # outside the lock: listeners re-read the store
+        fn(name, meta["version"], stage, list(archived))
+    return meta
+
+
+def resolve_stage(name: str, stage: str) -> Optional[Dict[str, Any]]:
+    """The version meta a stage alias ("Production"/"Staging") currently
+    resolves to: the LATEST READY version holding that stage, or None.
+    The lookup the serving endpoint performs at bind time and again on
+    every transition event."""
+    picked = None
+    for v in list_model_versions(name):
+        if v.get("current_stage") == stage and v.get("status") == "READY":
+            picked = v
+    return picked
 
 
 def update_model_version(name: str, version, description: str) -> Dict[str, Any]:

@@ -79,6 +79,39 @@ def test_score_batches_pipelined(fitted_lr):
     np.testing.assert_allclose(np.concatenate(outs), whole, rtol=1e-5)
 
 
+def test_prefetch_depth_configurable_and_overlap(fitted_lr):
+    """`sml.infer.prefetchBatches` replaces the hard-coded lookahead, and
+    the recorder's infer.* events prove the pipelining claim: batch i+1's
+    dispatch (prep + staging) lands BEFORE batch i's drain — staging of
+    the next batch overlaps compute/readback of the current one."""
+    import sml_tpu.obs as obs
+    from sml_tpu.conf import GLOBAL_CONF
+    pipe, _ = fitted_lr
+    # the tail model alone: no featurizer -> the pipelined dispatch loop
+    # (the factorized-linear branch is pure host work with no events)
+    scorer = DeviceScorer(pipe.stages[-1])
+    X = np.random.default_rng(2).normal(size=(4000, 3)).astype(np.float32)
+    batches = [X[i:i + 500] for i in range(0, 4000, 500)]
+    old = GLOBAL_CONF.get("sml.obs.enabled")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    GLOBAL_CONF.set("sml.infer.prefetchBatches", 3)
+    obs.reset()
+    try:
+        outs = list(scorer.score_batches(batches))
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", old)
+        GLOBAL_CONF.unset("sml.infer.prefetchBatches")
+    assert len(outs) == len(batches)
+    events = [(e.name, e.args.get("batch")) for e in obs.RECORDER.events()
+              if e.name.startswith("infer.")]
+    first_drain = events.index(("infer.drain", 0))
+    ahead = {b for name, b in events[:first_drain]
+             if name == "infer.dispatch"}
+    assert {0, 1, 2} <= ahead  # depth=3: three dispatches before drain 0
+    np.testing.assert_allclose(np.concatenate(outs), scorer.score_block(X),
+                               rtol=1e-6)
+
+
 def test_sharded_predict_large_batch_matches_small(fitted_lr):
     """The >=4096-row sharded path and the single-device path must agree."""
     pipe, _ = fitted_lr
