@@ -134,13 +134,29 @@ _register("sml.infer.prefetchBatches", 4, int,
           "of the drain point so batch i+1's prep + H2D staging overlaps "
           "batch i's compute and D2H (was a hard-coded 4). 1 = fully "
           "synchronous")
-_register("sml.cv.batchFolds", False, _to_bool,
-          "EXPERIMENTAL: fuse CrossValidator's k fold-fits per parameter "
-          "map into one vmapped device program for tree regressors. "
-          "Measured SLOWER on a single tunneled chip (the k-fold one-hot "
-          "working set is k x larger and fuses worse, while sequential "
-          "trials already pipeline host prep under device compute); kept "
-          "as an option for meshes where dispatch overhead dominates")
+_register("sml.cv.batchFolds", True, _to_bool,
+          "Fuse tree-regressor CV/TVS trial fits into vmapped device "
+          "programs. With sml.cv.maxFusedTrials > 1 the GRID axis fuses "
+          "too (per-trial hyperparameters pad to the grid maxima as "
+          "traced scalars), so a G-point grid over k folds costs "
+          "ceil(G*k/maxFusedTrials) tree-fit dispatches — the r01 bench's "
+          "ml07_cv/ml08 legs were dominated by dispatch COUNT, not "
+          "kernel time. Metrics match the placed-trials path within "
+          "float tolerance (below-max-depth trials derive terminal-level "
+          "stats from the level histograms rather than the dedicated "
+          "leaf pass); false forces placed trials")
+_register("sml.cv.maxFusedTrials", 16, int,
+          "Max (grid point x fold) trial fits fused into one device "
+          "dispatch by the grid-fused CV path (bounds the stacked "
+          "operand memory to ~maxFusedTrials fold copies); <= 1 falls "
+          "back to fold-only fusion (one dispatch per parameter map)")
+_register("sml.tune.candidatesPerDispatch", 4, int,
+          "TPE candidates proposed AND scored per generation for "
+          "batch-capable fmin objectives (fn.score_batch): a "
+          "tree-estimator objective backed by "
+          "ml.tuning.fused_param_scores pays one fused device dispatch "
+          "per generation instead of one per trial; <= 1 keeps the "
+          "sequential propose-score loop")
 
 
 class TpuConf:

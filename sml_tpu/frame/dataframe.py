@@ -760,11 +760,22 @@ class DataFrame:
         a compiled device program — amortize across the whole dataset, and
         lets device-backed UDF bodies (`DeviceScorer.score_batches`)
         pipeline host staging under device compute across batches.
+
+        The whole invocation is priced through `parallel.dispatch.decide`
+        with a per-cell WorkHint: a SMALL pandas-fn leg binds the host
+        mesh for the UDF's duration, so device-capable bodies inside it
+        (scorers) stop paying a tunnel round-trip per batch (r01's
+        ml12_mapinpandas ran 0.58x host exactly this way). Large legs
+        leave the inner per-batch routing untouched.
         """
         sch = parse_schema(schema)
         parent = self
 
         def compute():
+            import contextlib
+
+            from ..parallel import dispatch as _dispatch
+            from ..parallel import mesh as _meshlib
             parts = parent._materialize()
             bs = GLOBAL_CONF.getInt("sml.arrow.maxRecordsPerBatch")
 
@@ -775,7 +786,19 @@ class DataFrame:
                     for i in range(0, len(pdf), bs):
                         yield pdf.iloc[i:i + bs].reset_index(drop=True)
 
-            outs = [coerce_to_schema(b, sch) for b in fn(batches())]
+            n_rows = sum(len(p) for p in parts)
+            n_cols = max((len(p.columns) for p in parts), default=1)
+            # a linear-model-pass-per-cell estimate: generous to the fn
+            # body, but the decision only flips SMALL legs hostward,
+            # where the fixed per-dispatch tunnel latency dominates any
+            # body by orders of magnitude
+            hint = _dispatch.WorkHint(flops=2.0 * n_rows * max(n_cols, 1),
+                                      kind="blas", out_bytes=8.0 * n_rows)
+            route, _ = _dispatch.decide(hint)
+            ctx = (_meshlib.use_mesh_local(_dispatch.host_mesh())
+                   if route == "host" else contextlib.nullcontext())
+            with ctx:
+                outs = [coerce_to_schema(b, sch) for b in fn(batches())]
             return outs if outs else [coerce_to_schema(pd.DataFrame(), sch)]
 
         out = DataFrame(compute, session=self._session, schema=sch)

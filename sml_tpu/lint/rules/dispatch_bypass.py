@@ -39,9 +39,12 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
     "sml_tpu/ml/tree_impl.py": {
         "_compiled_chunk": "chunked-boosting program cache; each build is "
                            "reported via obs.note_compile('tree_chunk_*')",
-        "fit_ensembles_folds": "batched CV-folds program cache; builds are "
-                               "reported via obs.note_compile("
-                               "'tree_ensemble_folds_*')",
+        "_folds_compiled": "batched CV-folds program cache; builds are "
+                           "reported via obs.note_compile("
+                           "'tree_ensemble_folds_*')",
+        "_trials_compiled": "grid-fused trial-batch program cache; builds "
+                            "are reported via obs.note_compile("
+                            "'tree_ensemble_trials_*')",
         "_predict_binned": "module-level predict kernel (static depth); "
                            "host-side predict path whose traffic is visible "
                            "through the binning.predict span",

@@ -510,23 +510,86 @@ def data_parallel(fn: Callable, *, out_replicated: bool = True,
 _compiled_cache: dict = {}
 
 
+class _RecordingProgram:
+    """Thin callable over a compiled data_parallel program that records a
+    prewarm signature per DISTINCT arg-shape set (each set is its own XLA
+    executable; the manifest must name them all). Per-call cost once a
+    shape is seen: one tuple build + one set lookup."""
+
+    __slots__ = ("_compiled", "_src", "_flags", "_seen")
+
+    def __init__(self, compiled, src, flags):
+        self._compiled = compiled
+        self._src = src
+        self._flags = flags
+        self._seen: set = set()
+
+    def __call__(self, *args):
+        sig = tuple((np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
+                    for a in args)
+        if sig not in self._seen:
+            self._seen.add(sig)
+            from ..parallel import prewarm as _prewarm
+            out_rep, rep_nums = self._flags
+            _prewarm.record("data_parallel", {
+                "src": self._src, "out_replicated": bool(out_rep),
+                "replicated_argnums": list(rep_nums),
+                "args": [[list(s), d] for s, d in sig]})
+        return self._compiled(*args)
+
+
 def cached_data_parallel(fn: Callable, *, out_replicated: bool = True,
                          replicated_argnums: Tuple[int, ...] = ()) -> Callable:
     """data_parallel with a program cache keyed by (fn, mesh, flags).
 
     jax.jit caches per function object; wrapping a fresh closure per fit
     would recompile every call. Callers must pass module-level fns (stable
-    identity) for the cache to hit.
+    identity) for the cache to hit. Programs whose fn carries a
+    replayable source (module-level name or a `_prewarm` factory tag) are
+    wrapped to record their shapes into the prewarm manifest.
     """
     mesh = meshlib.get_mesh()
     key = (fn, id(mesh), out_replicated, replicated_argnums)
     if key not in _compiled_cache:
         from ..obs import note_compile
+        from ..parallel import prewarm as _prewarm
         note_compile(getattr(fn, "__name__", "fn"))
-        _compiled_cache[key] = data_parallel(
+        compiled = data_parallel(
             fn, out_replicated=out_replicated,
             replicated_argnums=replicated_argnums)
+        src = _prewarm.fn_src(fn)
+        if src is not None:
+            compiled = _RecordingProgram(
+                compiled, src, (out_replicated, replicated_argnums))
+        _compiled_cache[key] = compiled
     return _compiled_cache[key]
+
+
+def _replay_data_parallel(meta: dict) -> None:
+    """Prewarm rebuilder for `cached_data_parallel` programs: resolve the
+    fn, build through the SAME cache, and first-dispatch on zero-filled
+    operands placed like the live call sites place them (rows
+    data-sharded, replicated argnums left to jit placement)."""
+    from ..parallel import prewarm as _prewarm
+    fn = _prewarm.resolve_fn(meta["src"])
+    rep = tuple(int(i) for i in meta["replicated_argnums"])
+    compiled = cached_data_parallel(fn,
+                                    out_replicated=bool(meta["out_replicated"]),
+                                    replicated_argnums=rep)
+    mesh = meshlib.get_mesh()
+    args = []
+    for i, (shape, dtype) in enumerate(meta["args"]):
+        a = np.zeros(tuple(shape), dtype=np.dtype(dtype))
+        if i in rep or a.ndim == 0:
+            args.append(a)
+        else:
+            args.append(jax.device_put(a, meshlib.data_sharding(mesh, a.ndim)))
+    jax.device_get(compiled(*args))
+
+
+from ..parallel import prewarm as _prewarm_mod
+
+_prewarm_mod.register_rebuilder("data_parallel", _replay_data_parallel)
 
 
 def run_data_parallel(fn: Callable, *arrays, out_replicated: bool = True,

@@ -298,6 +298,102 @@ def _fit_ensemble_folds(Xs, ys, cats, *, max_depth: int, max_bins: int,
             for k, (trees, base) in enumerate(results)]
 
 
+def _fit_ensembles_grid(Xs, ys, cats, trials, max_fused: int,
+                        loss: str = "squared"):
+    """GRID-FUSED CV fits: `trials` carries one hyperparameter config per
+    grid point (max_depth, max_bins, min_instances, min_info_gain,
+    n_trees, feature_k (None = all features), bootstrap, subsample,
+    seed); every (grid point, fold) pair becomes one ELEMENT of the
+    trial-batched device program (`tree_impl.fit_ensembles_trials`),
+    dispatched in chunks of `max_fused` elements — a G-point grid over k
+    folds costs ceil(G*k / max_fused) tree-fit dispatches instead of G.
+
+    Static shapes are the grid MAXIMA (depth/bins/trees), so the whole
+    grid shares ONE compiled program per chunk width; each element gates
+    itself down to its own hyperparameters with traced scalars, and its
+    extra trees/nodes are sliced away host-side. Binning stays per
+    (fold, maxBins): a grid over maxBins legitimately re-quantizes,
+    everything else reuses the fold's cached matrices.
+
+    Returns {(grid_index, fold_index): _EnsembleSpec}."""
+    import jax
+
+    from ..parallel import mesh as _meshlib
+    from ._staging import routed_for
+
+    F = Xs[0].shape[1]
+    k = len(Xs)
+    y32s = [np.asarray(y, np.float32) for y in ys]
+    binned: Dict[tuple, np.ndarray] = {}
+    binnings: Dict[tuple, object] = {}
+    for mb in sorted({t["max_bins"] for t in trials}):
+        for fi, (X, y32) in enumerate(zip(Xs, y32s)):
+            b, bn = _cached_bins(X, y32, mb, cats)
+            binned[(fi, mb)] = b
+            binnings[(fi, mb)] = bn
+    D = max(t["max_depth"] for t in trials)
+    B = max(t["max_bins"] for t in trials)
+    T = max(t["n_trees"] for t in trials)
+    mesh = _meshlib.get_mesh()
+    n_dev = mesh.shape[_meshlib.DATA_AXIS]
+    n_pad = max(_meshlib.bucket_rows(b.shape[0], n_dev)
+                for b in binned.values())
+    stack_dtype = np.result_type(*[b.dtype for b in binned.values()])
+    spec = TreeSpec(max_depth=D, n_bins=B, n_features=F, feature_k=F,
+                    min_instances=1, min_info_gain=0.0, reg_lambda=0.0,
+                    gamma=0.0)
+    es = tree_impl.EnsembleSpec(tree=spec, n_trees=T, loss=loss,
+                                boosting=False, bootstrap=False,
+                                subsample=1.0, step_size=0.1)
+    elems = [(gi, fi) for gi in range(len(trials)) for fi in range(k)]
+    mode = "binary" if loss == "logistic" else "regression"
+    out: Dict[tuple, _EnsembleSpec] = {}
+    max_fused = max(1, int(max_fused))
+    for lo in range(0, len(elems), max_fused):
+        chunk = elems[lo:lo + max_fused]
+        E = len(chunk)
+        bst = np.zeros((E, n_pad, F), dtype=stack_dtype)
+        yst = np.zeros((E, n_pad), dtype=np.float32)
+        mst = np.zeros((E, n_pad), dtype=np.float32)
+        depth = np.zeros(E, np.int32)
+        feat_k = np.zeros(E, np.int32)
+        min_inst = np.zeros(E, np.float32)
+        min_gain = np.zeros(E, np.float32)
+        boot = np.zeros(E, bool)
+        sub = np.ones(E, np.float32)
+        rngs = np.zeros((E, 2), np.uint32)
+        n_rows = 0
+        for e, (gi, fi) in enumerate(chunk):
+            t = trials[gi]
+            b = binned[(fi, t["max_bins"])]
+            bst[e, :b.shape[0]] = b
+            yst[e, :len(y32s[fi])] = y32s[fi]
+            mst[e, :len(y32s[fi])] = 1.0
+            n_rows += b.shape[0]
+            depth[e] = t["max_depth"]
+            feat_k[e] = t["feature_k"] or F
+            min_inst[e] = t["min_instances"]
+            min_gain[e] = t["min_info_gain"]
+            boot[e] = bool(t["bootstrap"]) and t["n_trees"] > 1
+            sub[e] = t["subsample"]
+            rngs[e] = np.asarray(
+                jax.random.key_data(jax.random.PRNGKey(int(t["seed"]))),
+                np.uint32)
+        hint = dispatch.WorkHint(
+            flops=2.0 * T * D * n_rows * F * B, kind="scatter")
+        with routed_for(hint, bst, yst, mst, stacked=True):
+            packs, _bases = tree_impl.fit_ensembles_trials(
+                bst, yst, mst, es, rngs, depth, feat_k, min_inst,
+                min_gain, boot, sub)
+        for e, (gi, fi) in enumerate(chunk):
+            t = trials[gi]
+            trees = tree_impl._unpack_trees(packs[e][:t["n_trees"]])
+            out[(gi, fi)] = _EnsembleSpec(
+                trees, int(t["max_depth"]),
+                binnings[(fi, t["max_bins"])], None, 0.0, F, mode)
+    return out
+
+
 # ---------------------------------------------------------------------------
 class _TreeModelBase(Model, _TreeParams):
     """Shared transform/persistence for tree ensemble models."""

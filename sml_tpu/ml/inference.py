@@ -87,11 +87,22 @@ def _forest_margin(binned_b, sf, sb, lv, weights, depth: int):
     return jnp.sum(weights.astype(jnp.float32)[:, None] * per_tree, axis=0)
 
 
-def _make_forest_forward(depth: int):
-    def forest_forward(binned_b, mask, sf, sb, lv, weights):
-        return _forest_margin(binned_b, sf, sb, lv, weights, depth) * mask
+_forest_forwards: dict = {}
 
-    return forest_forward
+
+def _make_forest_forward(depth: int):
+    """Memoized per depth: the prewarm manifest replays forest programs
+    through this factory, and program caches key on fn IDENTITY — a
+    fresh closure per call would compile a parallel universe of
+    executables instead of warming the live ones."""
+    fn = _forest_forwards.get(depth)
+    if fn is None:
+        def forest_forward(binned_b, mask, sf, sb, lv, weights):
+            return _forest_margin(binned_b, sf, sb, lv, weights, depth) * mask
+
+        forest_forward._prewarm = ("forest_forward", {"depth": int(depth)})
+        _forest_forwards[depth] = fn = forest_forward
+    return fn
 
 
 _forest_programs: dict = {}
@@ -157,8 +168,22 @@ def forest_eval_fn(depth: int, link: str = "identity"):
 
     forest_eval.__name__ = f"forest_eval_d{depth}" + \
         ("" if link == "identity" else f"_{link}")
+    forest_eval._prewarm = ("forest_eval", {"depth": int(depth),
+                                            "link": str(link)})
     _forest_eval_fns[key] = forest_eval
     return forest_eval
+
+
+def _register_prewarm_factories() -> None:
+    from ..parallel import prewarm as _prewarm
+    _prewarm.register_fn_factory(
+        "forest_forward", lambda m: _make_forest_forward(int(m["depth"])))
+    _prewarm.register_fn_factory(
+        "forest_eval", lambda m: forest_eval_fn(int(m["depth"]),
+                                                str(m["link"])))
+
+
+_register_prewarm_factories()
 
 
 def _stage_rows(X: np.ndarray):

@@ -60,6 +60,18 @@ class FittedTree(NamedTuple):
     cover: np.ndarray           # (N,) float32 hessian mass per node
 
 
+class TrialDyn(NamedTuple):
+    """Per-TRIAL hyperparameters as TRACED scalars (grid-fused batching):
+    the program is compiled once at the grid MAXIMA (static shapes come
+    from TreeSpec), and each vmapped trial gates itself down to its own
+    hyperparameters at run time — a grid over maxDepth x numTrees x ...
+    is ONE executable, not one per grid point."""
+    depth: object           # splits allowed only at level < depth
+    feature_k: object       # RF subspace width (== n_features disables)
+    min_instances: object   # min hessian-count per child
+    min_info_gain: object   # min split gain
+
+
 class Binning(NamedTuple):
     edges: np.ndarray           # (F, B-1) float32 upper-inclusive thresholds (+inf padded)
     cat_remap: Dict[int, np.ndarray]  # slot -> category->rank map (label-mean order)
@@ -250,11 +262,20 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
     (each parent was itself subtraction-derived), so a weight sum sitting
     exactly on the min_instances boundary can gate differently than the
     direct build. Nodes whose parent did NOT split are gated to zero,
-    exactly matching the direct computation (no rows ever reach them)."""
+    exactly matching the direct computation (no rows ever reach them).
+
+    `build(..., dyn=TrialDyn(...))` swaps depth / feature_k /
+    min_instances / min_info_gain for TRACED per-trial scalars (the
+    grid-fused batching path): the loop still unrolls to spec.max_depth,
+    but splits are gated off at level >= dyn.depth, so a shallower trial
+    produces the tree its own static program would have (deeper nodes
+    keep zero cover and inherit the parent value)."""
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
     n_nodes = 2 ** (D + 1) - 1
 
-    def build(B1t, binned, grad, hess, weight, feat_rng):
+    def build(B1t, binned, grad, hess, weight, feat_rng, dyn=None):
+        min_inst = spec.min_instances if dyn is None else dyn.min_instances
+        min_gain = spec.min_info_gain if dyn is None else dyn.min_info_gain
         n = binned.shape[0]
         node = jnp.zeros((n,), dtype=jnp.int32)
         # EVERY row routes down the tree (active = still on a splitting
@@ -330,15 +351,20 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
             score = (GL ** 2 / (HL + lam + 1e-12)
                      + (G - GL) ** 2 / (H - HL + lam + 1e-12)
                      - G ** 2 / (H + lam + 1e-12))
-            ok = ((WL >= spec.min_instances)
-                  & ((W - WL) >= spec.min_instances))
+            ok = ((WL >= min_inst)
+                  & ((W - WL) >= min_inst))
             ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
-            if spec.feature_k < F:
+            if dyn is not None or spec.feature_k < F:
+                # under dyn the draw ALWAYS happens (feature_k is traced);
+                # with feature_k == F the mask is all-True, so a
+                # no-subspace trial sees the identical candidate set its
+                # own static program (which skips the draw) produces
                 u = jax.random.uniform(
                     jax.random.fold_in(jax.random.wrap_key_data(feat_rng), level),
                     (width, F))
                 ranks = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
-                ok = ok & (ranks < spec.feature_k)[:, :, None]
+                fk = spec.feature_k if dyn is None else dyn.feature_k
+                ok = ok & (ranks < fk)[:, :, None]
             score = jnp.where(ok, score, -jnp.inf)
             flat_best = jnp.argmax(score.reshape(width, F * B), axis=1)
             best_f = (flat_best // B).astype(jnp.int32)
@@ -346,7 +372,9 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
             best_gain = 0.5 * jnp.take_along_axis(
                 score.reshape(width, F * B), flat_best[:, None], axis=1)[:, 0] \
                 - spec.gamma
-            do_split = (best_gain > spec.min_info_gain) & jnp.isfinite(best_gain)
+            do_split = (best_gain > min_gain) & jnp.isfinite(best_gain)
+            if dyn is not None:  # trial's own maxDepth: no splits beyond it
+                do_split = do_split & (level < dyn.depth)
             idx = base + jnp.arange(width)
             node_G = node_G.at[idx].set(G[:, 0, 0])
             node_H = node_H.at[idx].set(H[:, 0, 0])
@@ -599,11 +627,18 @@ def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
     margin_bytes = margin.nbytes
     LEDGER.alloc("boost_margin", margin_bytes)
     try:
+        from ..parallel import prewarm as _prewarm
+        from ..utils.profiler import PROFILER
         rng = jax.random.key_data(jax.random.PRNGKey(seed))
         packs_parts = []
         t0 = 0
         while t0 < es.n_trees:
             c = min(chunk, es.n_trees - t0)
+            _prewarm.record("tree_chunk", {
+                "es": _es_meta(es), "chunk": int(c),
+                "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev,
+                                           margin)})
+            PROFILER.count("tree.fit_dispatch")
             margin, packs = _compiled_chunk(es, c)(
                 binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
             packs_parts.append(packs)
@@ -631,24 +666,36 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                                        rounds_per_dispatch)
 
 
+def _ensemble_compiled(es: EnsembleSpec):
+    """The monolithic whole-ensemble program from its per-mesh cache —
+    shared by the fit path and the prewarm rebuilder (warming must
+    populate the SAME cache entry the fit will hit)."""
+    key = (es, id(meshlib.get_mesh()), _hist_subtract())
+    if key not in _ensemble_cache:
+        from ..obs import note_compile
+        note_compile("tree_ensemble")
+        _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
+                                             replicated_argnums=(3,))
+    return _ensemble_cache[key]
+
+
 def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                             seed: int = 0,
                             rounds_per_dispatch: Optional[int] = None):
-    from ..parallel import mesh as _meshlib
     from ..conf import GLOBAL_CONF
     rounds = (rounds_per_dispatch if rounds_per_dispatch is not None
               else GLOBAL_CONF.getInt("sml.tree.roundsPerDispatch"))
     if es.boosting and 0 < rounds < es.n_trees:
         return _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es,
                                      seed, rounds)
-    key = (es, id(_meshlib.get_mesh()), _hist_subtract())
-    if key not in _ensemble_cache:
-        from ..obs import note_compile
-        note_compile("tree_ensemble")
-        _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
-                                             replicated_argnums=(3,))
-    compiled = _ensemble_cache[key]
+    compiled = _ensemble_compiled(es)
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
+    from ..parallel import prewarm as _prewarm
+    from ..utils.profiler import PROFILER
+    _prewarm.record("tree_ensemble", {
+        "es": _es_meta(es),
+        "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev)})
+    PROFILER.count("tree.fit_dispatch")
     packs, base = jax.device_get(compiled(binned_dev, y_dev, mask_dev, rng))
     # ^ one batched D2H transfer for (packs, base): the tunnel charges a
     # fixed latency per transfer, so never fetch leaves separately
@@ -739,6 +786,25 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
     y_dev = stage_stacked_cached(yst)
     m_dev = stage_stacked_cached(mst)
 
+    compiled = _folds_compiled(es, fo)
+    from ..parallel import prewarm as _prewarm
+    _prewarm.record("tree_folds", {
+        "es": _es_meta(es), "fo": int(fo),
+        "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
+    rng = jax.random.key_data(jax.random.PRNGKey(seed))
+    with PROFILER.span(
+            "program.tree_ensemble_folds", rows=int(fo * n_pad),
+            route="host" if _dispatch.is_host_mesh(mesh) else "device",
+            trees=es.n_trees * fo):
+        PROFILER.count("tree.fit_dispatch")
+        packs, bases = jax.device_get(compiled(b_dev, y_dev, m_dev, rng))
+    return [(_unpack_trees(packs[k]), float(bases[k])) for k in range(fo)]
+
+
+def _folds_compiled(es: EnsembleSpec, fo: int):
+    """The fold-batched program from its per-mesh cache (shared with the
+    prewarm rebuilder)."""
+    mesh = meshlib.get_mesh()
     key = (es, fo, id(mesh), _hist_subtract())
     if key not in _folds_cache:
         from ..obs import note_compile
@@ -750,21 +816,223 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
                 binned_f, y_f, mask_f, rng)
 
         P = jax.sharding.PartitionSpec
-        D = _meshlib.DATA_AXIS
-        wrapped = _meshlib.shard_map_compat(
+        D = meshlib.DATA_AXIS
+        wrapped = meshlib.shard_map_compat(
             batched, mesh=mesh,
             in_specs=(P(None, D, None), P(None, D), P(None, D), P()),
             out_specs=(P(), P()))
         _folds_cache[key] = jax.jit(wrapped)
-    compiled = _folds_cache[key]
+    return _folds_cache[key]
 
-    rng = jax.random.key_data(jax.random.PRNGKey(seed))
+
+# ------------------------------------------------- grid-fused trial batching
+_trials_cache: Dict[tuple, object] = {}
+
+
+def _make_trials_program(es: EnsembleSpec):
+    """Per-ELEMENT ensemble program with TRACED hyperparameters, vmapped
+    over the trial axis by `fit_ensembles_trials`: `es` carries the grid
+    MAXIMA as static shapes (max_depth, n_bins, n_trees), and each
+    element's `TrialDyn` + sampling flags gate the build down to its own
+    hyperparameters. Sampling weights select among poisson / bernoulli /
+    ones draws from the SAME keys the per-trial static programs use, so
+    the selected values match the unfused path draw-for-draw."""
+    spec = es.tree
+    hist_dtype = _hist_dtype()
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
+    B, F = spec.n_bins, spec.n_features
+    base_of = _base_margin_fn(es.loss)
+
+    def program(binned, y, mask, rng, depth, feature_k, min_inst, mig,
+                bootstrap, subsample):
+        n = binned.shape[0]
+        binned = binned.astype(jnp.int32)
+        B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
+            .reshape(n, F * B).T
+        key = jax.random.fold_in(jax.random.wrap_key_data(rng),
+                                 coll.axis_index())
+        base = base_of(y, mask)
+        dyn = TrialDyn(depth=depth, feature_k=feature_k,
+                       min_instances=min_inst, min_info_gain=mig)
+
+        def round_fn(carry, t):
+            grad = -y
+            hess = jnp.ones_like(y)
+            kt = jax.random.fold_in(key, t)
+            pois = jax.random.poisson(kt, subsample, (n,)) \
+                .astype(jnp.float32)
+            bern = jax.random.bernoulli(kt, subsample, (n,)) \
+                .astype(jnp.float32)
+            ones = jnp.ones((n,), jnp.float32)
+            w = jnp.where(bootstrap, pois,
+                          jnp.where(subsample < 1.0, bern, ones)) * mask
+            feat_rng = jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(rng), t))
+            pack, _ = build(B1t, binned, grad, hess, w, feat_rng, dyn=dyn)
+            return carry, pack
+
+        _, packs = jax.lax.scan(round_fn, 0.0, jnp.arange(es.n_trees))
+        return packs, base
+
+    return program
+
+
+def _trials_compiled(es: EnsembleSpec, n_elems: int):
+    """The trial-batched program from its per-mesh cache (shared with the
+    prewarm rebuilder). Cache key carries only STATIC maxima — a grid
+    whose per-trial values change but whose maxima land on the same
+    (depth, bins, trees) signature replays one executable."""
+    mesh = meshlib.get_mesh()
+    key = (es, n_elems, id(mesh), _hist_subtract())
+    if key not in _trials_cache:
+        from ..obs import note_compile
+        note_compile(f"tree_ensemble_trials_{n_elems}")
+        program = _make_trials_program(es)
+
+        def batched(binned_e, y_e, mask_e, rngs, *dyns):
+            return jax.vmap(program,
+                            in_axes=(0,) * (4 + len(dyns)))(
+                binned_e, y_e, mask_e, rngs, *dyns)
+
+        P = jax.sharding.PartitionSpec
+        D = meshlib.DATA_AXIS
+        wrapped = meshlib.shard_map_compat(
+            batched, mesh=mesh,
+            in_specs=(P(None, D, None), P(None, D), P(None, D))
+            + (P(),) * 7,
+            out_specs=(P(), P()))
+        _trials_cache[key] = jax.jit(wrapped)
+    return _trials_cache[key]
+
+
+def fit_ensembles_trials(bst, yst, mst, es: EnsembleSpec, rngs,
+                         depth, feature_k, min_inst, min_gain,
+                         bootstrap, subsample):
+    """Fit E = bst.shape[0] (grid point × fold) TRIALS as ONE vmapped
+    device program — the grid-fused extension of `fit_ensembles_folds`:
+    per-trial hyperparameters ride as traced (E,)-vectors (padded to the
+    grid maxima carried statically by `es`), so a G-point grid over k
+    folds is ceil(G*k / sml.cv.maxFusedTrials) dispatches instead of G*k
+    (or G). Rows shard over the data axis; the element axis is
+    replicated, exactly like the fold axis in the fold-only program.
+
+    Returns the raw (E, n_trees, 5, n_nodes) pack stack + (E,) bases —
+    the caller slices each element down to its own numTrees."""
+    from ..parallel import dispatch as _dispatch
+    from ..parallel import prewarm as _prewarm
+    from ..utils.profiler import PROFILER
+    from ._staging import stage_stacked_cached
+
+    mesh = meshlib.get_mesh()
+    E, n_pad = bst.shape[0], bst.shape[1]
+    b_dev = stage_stacked_cached(bst)
+    y_dev = stage_stacked_cached(yst)
+    m_dev = stage_stacked_cached(mst)
+    compiled = _trials_compiled(es, E)
+    _prewarm.record("tree_trials", {
+        "es": _es_meta(es), "n_elems": int(E),
+        "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
     with PROFILER.span(
-            "program.tree_ensemble_folds", rows=int(fo * n_pad),
+            "program.tree_ensemble_trials", rows=int(E * n_pad),
             route="host" if _dispatch.is_host_mesh(mesh) else "device",
-            trees=es.n_trees * fo):
-        packs, bases = jax.device_get(compiled(b_dev, y_dev, m_dev, rng))
-    return [(_unpack_trees(packs[k]), float(bases[k])) for k in range(fo)]
+            trees=es.n_trees * E):
+        PROFILER.count("tree.fit_dispatch")
+        packs, bases = jax.device_get(compiled(
+            b_dev, y_dev, m_dev, np.asarray(rngs),
+            np.asarray(depth, np.int32), np.asarray(feature_k, np.int32),
+            np.asarray(min_inst, np.float32),
+            np.asarray(min_gain, np.float32),
+            np.asarray(bootstrap, bool),
+            np.asarray(subsample, np.float32)))
+    return packs, bases
+
+
+# ------------------------------------------------------- prewarm rebuilders
+def _es_meta(es: EnsembleSpec) -> dict:
+    """JSON-serializable EnsembleSpec for the prewarm manifest."""
+    return {"tree": list(es.tree), "n_trees": int(es.n_trees),
+            "loss": str(es.loss), "boosting": bool(es.boosting),
+            "bootstrap": bool(es.bootstrap),
+            "subsample": float(es.subsample),
+            "step_size": float(es.step_size)}
+
+
+def _es_from_meta(meta: dict) -> EnsembleSpec:
+    meta = meta.get("es", meta)
+    t = meta["tree"]
+    return EnsembleSpec(
+        tree=TreeSpec(int(t[0]), int(t[1]), int(t[2]), int(t[3]),
+                      int(t[4]), float(t[5]), float(t[6]), float(t[7])),
+        n_trees=int(meta["n_trees"]), loss=str(meta["loss"]),
+        boosting=bool(meta["boosting"]), bootstrap=bool(meta["bootstrap"]),
+        subsample=float(meta["subsample"]),
+        step_size=float(meta["step_size"]))
+
+
+def _replay_zeros(meta, n: int):
+    """Zero-filled device operands in the recorded shapes/dtypes, placed
+    exactly like the fit paths place them (data-sharded rows; stacked
+    layouts keep the leading axis replicated) so the replayed dispatch
+    hits the very executable the recorded call compiled."""
+    mesh = meshlib.get_mesh()
+    stacked = ("n_elems" in meta) or ("fo" in meta)
+    out = []
+    for shape, dtype in meta["args"][:n]:
+        a = np.zeros(tuple(shape), dtype=np.dtype(dtype))
+        if stacked and a.ndim >= 2:  # (elems/folds, rows, ...) layout
+            spec = jax.sharding.PartitionSpec(
+                None, meshlib.DATA_AXIS, *([None] * (a.ndim - 2)))
+            out.append(jax.device_put(
+                a, jax.sharding.NamedSharding(mesh, spec)))
+        else:
+            out.append(jax.device_put(a, meshlib.data_sharding(mesh, a.ndim)))
+    return out
+
+
+def _replay_tree_ensemble(meta: dict) -> None:
+    es = _es_from_meta(meta)
+    b, y, m = _replay_zeros(meta, 3)
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    jax.device_get(_ensemble_compiled(es)(b, y, m, rng))
+
+
+def _replay_tree_chunk(meta: dict) -> None:
+    es = _es_from_meta(meta)
+    b, y, m, margin = _replay_zeros(meta, 4)
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    jax.device_get(_compiled_chunk(es, int(meta["chunk"]))(
+        b, y, m, margin, rng, jnp.int32(0)))
+
+
+def _replay_tree_folds(meta: dict) -> None:
+    es = _es_from_meta(meta)
+    b, y, m = _replay_zeros(meta, 3)
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    jax.device_get(_folds_compiled(es, int(meta["fo"]))(b, y, m, rng))
+
+
+def _replay_tree_trials(meta: dict) -> None:
+    es = _es_from_meta(meta)
+    E = int(meta["n_elems"])
+    b, y, m = _replay_zeros(meta, 3)
+    rngs = np.zeros((E, 2), np.uint32)
+    jax.device_get(_trials_compiled(es, E)(
+        b, y, m, rngs,
+        np.full(E, es.tree.max_depth, np.int32),
+        np.full(E, es.tree.n_features, np.int32),
+        np.ones(E, np.float32), np.zeros(E, np.float32),
+        np.zeros(E, bool), np.ones(E, np.float32)))
+
+
+def _register_prewarm_rebuilders() -> None:
+    from ..parallel import prewarm as _prewarm
+    _prewarm.register_rebuilder("tree_ensemble", _replay_tree_ensemble)
+    _prewarm.register_rebuilder("tree_chunk", _replay_tree_chunk)
+    _prewarm.register_rebuilder("tree_folds", _replay_tree_folds)
+    _prewarm.register_rebuilder("tree_trials", _replay_tree_trials)
+
+
+_register_prewarm_rebuilders()
 
 
 def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32):
@@ -799,6 +1067,8 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
     compiled = _tree_cache[key]
     if feat_key is None:
         feat_key = jax.random.key_data(jax.random.PRNGKey(rng))
+    from ..utils.profiler import PROFILER
+    PROFILER.count("tree.fit_dispatch")
     out = compiled(binned_dev, grad_dev, hess_dev, weight_dev, feat_key)
     sf, sb, lv, g, cov = jax.device_get(out)  # one batched transfer
     sf, lv = sf.copy(), lv.copy()

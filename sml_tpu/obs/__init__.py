@@ -58,9 +58,13 @@ def reset() -> None:
 
 def note_compile(name: str) -> None:
     """Mark a program-cache miss (= a fresh trace + XLA compile/replay):
-    bumps the `compile.programs` counter and records a compile event."""
+    bumps the `compile.programs` total AND the per-name
+    `compile.program.<name>` counter (bench legs derive their
+    distinct-program / first-dispatch attribution from the per-name
+    deltas), and records a compile event."""
     from ..utils.profiler import PROFILER
     PROFILER.count("compile.programs")
+    PROFILER.count(f"compile.program.{name}")
     if RECORDER.enabled:
         RECORDER.emit("compile", "compile.trace", args={"program": name})
 

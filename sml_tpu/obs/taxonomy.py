@@ -39,6 +39,13 @@ COUNTERS = {
     "shuffle.rows", "shuffle.bytes",
     "cv.batchFolds.fallback",
     "compile.programs",
+    "compile.program.*",  # per-name program-cache-miss counts (bench
+                          # derives distinct-programs-per-leg from these)
+    "tree.fit_dispatch",  # device launches of tree-fit programs (the
+                          # grid-fused CV dispatch-count contract)
+    # prewarm manifest (parallel/prewarm.py): recorded signatures,
+    # replayed/failed first-dispatches, pool-size attribution
+    "prewarm.*",
     "dispatch.route_*",   # dispatch.route_host / dispatch.route_device
     "collective.*",       # per-trace collective launch counts
     # serving layer (sml_tpu/serving): request admission, micro-batch
@@ -64,6 +71,7 @@ EVENTS = {
     "compile.*",          # compile.trace / compile.cache_dir
     "serve.*",            # serve.swap (endpoint hot-swap receipts)
     "infer.*",            # infer.dispatch / infer.drain (batch pipelining)
+    "prewarm.*",          # prewarm.start / prewarm.replay / prewarm.done
 }
 
 _BY_KIND = {"span": SPANS, "count": COUNTERS, "counter": COUNTERS,

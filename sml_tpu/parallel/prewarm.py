@@ -1,0 +1,276 @@
+"""Concurrent program-prewarm manifest — amortize first-dispatch latency.
+
+The r01 bench measured 260.7s of warmup that is NOT XLA recompilation:
+with the persistent compile cache warm, every program *loads* as a cache
+hit, but each of the ~25 distinct executables still pays a first-dispatch
+tax on the tunneled backend (executable ship + device load + python
+trace), serially, one program at a time as the suite first reaches it.
+
+This module turns that serial sum into an overlapped pool:
+
+- RECORDING (always on once a compile-cache directory exists): every
+  program family dispatched through `ml._staging.cached_data_parallel`,
+  the tree program caches (`tree_impl`), or `DeviceScorer` records a
+  replayable signature — a family kind, the static build parameters, the
+  padded operand shapes/dtypes, and the mesh signature — into
+  `prewarm_manifest.json` next to the `sml.compile.cacheDir` artifacts.
+  Recording is a dict lookup + an occasional atomic file write; it never
+  touches the device.
+
+- REPLAY (opt-in, `sml.prewarm.enabled`): `prewarm()` rebuilds every
+  manifest program through the SAME per-process caches the real call
+  sites hit and first-dispatches it on zero-filled operands of the
+  recorded shapes from a `sml.prewarm.workers`-wide thread pool, so the
+  per-program payments overlap instead of summing. Entries whose mesh
+  signature (data-axis width + platform) doesn't match the live mesh are
+  skipped — a manifest written under 8 virtual devices cannot be
+  replayed onto 1 chip.
+
+Every replay emits `prewarm.*` counters/events through the flight
+recorder, so the overlap is visible in the trace and assertable in
+tests. See docs/PERF.md ("Dispatch economics").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..conf import GLOBAL_CONF, _register, _to_bool
+from ..obs._recorder import RECORDER as _OBS
+from ..utils.profiler import PROFILER, now as _now
+
+_register("sml.prewarm.enabled", False, _to_bool,
+          "Replay the program-prewarm manifest at process start: rebuild "
+          "and first-dispatch every recorded program signature from a "
+          "background thread pool (sml.prewarm.workers wide) so the "
+          "per-program first-dispatch payments on a tunneled backend "
+          "overlap instead of summing. Recording into the manifest is "
+          "always on (passive, host-only); this knob gates only the "
+          "replay")
+_register("sml.prewarm.workers", 4, int,
+          "Thread-pool width for manifest replay: how many recorded "
+          "programs rebuild + first-dispatch concurrently")
+
+_MANIFEST_VERSION = 1
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"path": None, "entries": None}
+_tls = threading.local()  # replay re-entrancy guard
+_ran = {"done": False}
+
+#: kind -> rebuilder(meta) — populated by tree_impl / inference /
+#: _staging at import; prewarm() imports them before replaying.
+_REBUILDERS: Dict[str, Callable[[dict], None]] = {}
+
+#: family -> factory(meta) -> program fn. For program fns that are
+#: FACTORY-made (closures over static params, not importable by name):
+#: the factory must be memoized so replay resolves the SAME fn object
+#: the live call sites use — program caches key on fn identity.
+_FN_FACTORIES: Dict[str, Callable[[dict], Callable]] = {}
+
+
+def register_rebuilder(kind: str, fn: Callable[[dict], None]) -> None:
+    _REBUILDERS[kind] = fn
+
+
+def register_fn_factory(family: str, fn: Callable[[dict], Callable]) -> None:
+    _FN_FACTORIES[family] = fn
+
+
+def resolve_fn(src: list):
+    """The program fn behind a recorded `data_parallel` signature:
+    ["import", module, qualname] resolves by import; ["factory", family,
+    meta] through the registered memoized factory."""
+    if src[0] == "import":
+        import importlib
+        return getattr(importlib.import_module(src[1]), src[2])
+    return _FN_FACTORIES[src[1]](src[2])
+
+
+def fn_src(fn) -> Optional[list]:
+    """A recordable source for a program fn, or None (unrecordable —
+    e.g. an untagged local closure). Tagged factory fns (`fn._prewarm =
+    (family, meta)`) win; otherwise only a module-level name that
+    round-trips back to the same object qualifies."""
+    tag = getattr(fn, "_prewarm", None)
+    if tag is not None:
+        return ["factory", str(tag[0]), dict(tag[1])]
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", "")
+    if mod and qual and "." not in qual:
+        import sys
+        m = sys.modules.get(mod)
+        if m is not None and getattr(m, qual, None) is fn:
+            return ["import", mod, qual]
+    return None
+
+
+def arg_specs(*arrays) -> List[list]:
+    """[[shape, dtype], ...] for device/host operands — the shape half of
+    a program's replayable signature."""
+    return [[list(a.shape), str(a.dtype)] for a in arrays]
+
+
+def manifest_path() -> Optional[str]:
+    """The manifest lives next to the persistent XLA compile-cache
+    artifacts (they describe the same executables); None when compile
+    caching is off (nothing persists across processes to prewarm)."""
+    from . import dispatch
+    d = dispatch.ensure_compile_cache()
+    if not d:
+        return None
+    return os.path.join(d, "prewarm_manifest.json")
+
+
+def _mesh_sig() -> list:
+    from . import mesh as meshlib
+    m = meshlib.get_mesh()
+    n = int(m.shape.get(meshlib.DATA_AXIS, 1))
+    plat = str(list(m.devices.flat)[0].platform)
+    return [n, plat]
+
+
+def _load(path: str) -> Dict[str, dict]:
+    with _lock:
+        if _state["path"] == path and _state["entries"] is not None:
+            return _state["entries"]
+    entries: Dict[str, dict] = {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("version") == _MANIFEST_VERSION:
+            entries = dict(doc.get("entries", {}))
+    except (OSError, ValueError):
+        entries = {}
+    with _lock:
+        _state["path"] = path
+        _state["entries"] = entries
+    return entries
+
+
+def _flush(path: str) -> None:
+    """Atomic write (tmp + rename) so a concurrently-starting process
+    never reads a torn manifest."""
+    with _lock:
+        doc = {"version": _MANIFEST_VERSION,
+               "entries": dict(_state["entries"] or {})}
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # recording is best-effort; never fail a fit over it
+
+
+def record(kind: str, meta: dict) -> None:
+    """Record one replayable program signature (idempotent per distinct
+    (kind, meta, mesh) — repeated dispatches of the same program cost one
+    canonical-JSON hash and a set lookup)."""
+    if getattr(_tls, "replaying", False):
+        return  # replays must not re-record (or flush) their own entries
+    path = manifest_path()
+    if path is None:
+        return
+    entry = {"kind": kind, "meta": meta, "mesh": _mesh_sig()}
+    try:
+        blob = json.dumps(entry, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return
+    key = hashlib.sha1(blob.encode()).hexdigest()[:20]
+    entries = _load(path)
+    with _lock:
+        if key in entries:
+            return
+        entries[key] = entry
+    PROFILER.count("prewarm.recorded")
+    _flush(path)
+
+
+def _replay_one(entry: dict, stats: dict, stats_lock) -> None:
+    _tls.replaying = True
+    t0 = _now()
+    ok = True
+    try:
+        _REBUILDERS[entry["kind"]](entry["meta"])
+    except Exception:
+        ok = False
+    finally:
+        _tls.replaying = False
+    dt = _now() - t0
+    with stats_lock:
+        stats["replayed" if ok else "failed"] += 1
+        stats["serial_s"] += dt
+    if ok:
+        PROFILER.count("prewarm.replayed")
+    else:
+        PROFILER.count("prewarm.failed")
+    if _OBS.enabled:
+        _OBS.emit("prewarm", "prewarm.replay",
+                  args={"kind": entry["kind"], "ok": ok,
+                        "seconds": round(dt, 4)})
+
+
+def prewarm(workers: Optional[int] = None) -> dict:
+    """Rebuild + first-dispatch every matching manifest program from a
+    thread pool. Returns {programs, replayed, failed, skipped, wall_s,
+    serial_s}: serial_s is what the same payments would have cost one at
+    a time — serial_s / wall_s is the overlap the pool bought."""
+    # rebuilders live in the modules that own the program caches
+    from ..ml import _staging, inference, tree_impl  # noqa: F401
+    _ran["done"] = True
+    path = manifest_path()
+    entries = _load(path) if path else {}
+    sig = _mesh_sig()
+    todo = [e for e in entries.values()
+            if e.get("mesh") == sig and e.get("kind") in _REBUILDERS]
+    stats = {"programs": len(todo), "replayed": 0, "failed": 0,
+             "skipped": len(entries) - len(todo),
+             "wall_s": 0.0, "serial_s": 0.0}
+    if not todo:
+        return stats
+    if workers is None:
+        workers = GLOBAL_CONF.getInt("sml.prewarm.workers")
+    workers = max(1, int(workers))
+    PROFILER.count("prewarm.programs", float(len(todo)))
+    if _OBS.enabled:
+        _OBS.emit("prewarm", "prewarm.start",
+                  args={"programs": len(todo), "workers": workers})
+    t0 = _now()
+    stats_lock = threading.Lock()
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="sml-prewarm") as pool:
+        for f in [pool.submit(_replay_one, e, stats, stats_lock)
+                  for e in todo]:
+            f.result()
+    stats["wall_s"] = _now() - t0
+    if _OBS.enabled:
+        _OBS.emit("prewarm", "prewarm.done", args=dict(stats))
+    return stats
+
+
+def maybe_prewarm(block: bool = False) -> Optional[object]:
+    """The opt-in process-start hook (bench warmup, serving endpoint
+    load): replay the manifest once per process when
+    `sml.prewarm.enabled` is set — in a background thread by default, so
+    model loads overlap the warmup instead of waiting on it."""
+    if not GLOBAL_CONF.getBool("sml.prewarm.enabled"):
+        return None
+    with _lock:
+        # claim BEFORE spawning: two endpoints constructed back-to-back
+        # must not both launch a replay (the thread sets nothing until it
+        # is scheduled — check-then-act on the thread's own flag races)
+        if _ran["done"]:
+            return None
+        _ran["done"] = True
+    if block:
+        return prewarm()
+    t = threading.Thread(target=prewarm, daemon=True, name="sml-prewarm")
+    t.start()
+    return t

@@ -78,6 +78,12 @@ class ServingEndpoint:
                         "max_abs_diff": 0.0}
         self._shadow_pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
+        # opt-in manifest replay (sml.prewarm.enabled), once per process,
+        # in the background: a later hot-swap finds its scorer programs
+        # (forest/linear forwards over the serving shape buckets) already
+        # first-dispatched instead of paying the tunnel tax mid-traffic
+        from ..parallel import prewarm as _prewarm
+        _prewarm.maybe_prewarm()
         self._refresh(initial=True)
         self._listener = self._on_transition if auto_update else None
         if self._listener is not None:

@@ -218,18 +218,49 @@ def fmin(fn: Callable, space: Dict[str, Dimension], algo=None,
                   f"{res.get('loss')}")
 
     width = getattr(trials, "parallelism", 1)
-    if width <= 1:
+    # BATCH-CAPABLE objectives (fn.score_batch(values_list) -> losses):
+    # candidates are proposed AND SCORED per generation, so an objective
+    # backed by the grid-fused tree evaluator
+    # (ml.tuning.fused_param_scores) pays ONE device dispatch per
+    # generation instead of one per trial. score_batch returning None (or
+    # raising) drops that generation to the ordinary per-trial path —
+    # same proposals, same losses, just unfused dispatches.
+    score_batch = getattr(fn, "score_batch", None)
+    from ..conf import GLOBAL_CONF
+    gen = GLOBAL_CONF.getInt("sml.tune.candidatesPerDispatch") \
+        if callable(score_batch) else 1
+    if max(width, gen) <= 1:
         while len(trials) < max_evals:
             run_one(suggest(space, trials, rstate))
     else:
         from ..parallel.mesh import run_placed_trials
         while len(trials) < max_evals:
-            batch = min(width, max_evals - len(trials))
+            batch = min(max(width, gen), max_evals - len(trials))
             # batch proposals draw from the same posterior; rng state
             # advances per proposal so the batch is diverse
             proposals = [suggest(space, trials, rstate) for _ in range(batch)]
+            results = None
+            if callable(score_batch) and batch > 1:
+                values = [space_eval(space, p) for p in proposals]
+                try:
+                    results = score_batch(values)
+                except Exception:
+                    results = None  # unfused path is always correct
+            if results is not None:
+                for p, res in zip(proposals, results):
+                    trials.record(p, _normalize_result(res))
+                    if verbose:
+                        print(f"trial {len(trials)}/{max_evals}: "
+                              f"{space_eval(space, p)} -> "
+                              f"{trials.losses()[-1]}")
+                continue
             # each worker thread is bound to its own submesh of the chip
             # pool — trials training JAX models land on disjoint chips
-            # (SparkTrials' trial→executor placement, SURVEY P7)
-            run_placed_trials(proposals, run_one, batch)
+            # (SparkTrials' trial→executor placement, SURVEY P7).
+            # Concurrency is capped at the USER'S parallelism, never the
+            # generation size: a declined score_batch on a parallelism=1
+            # store must fall back to sequential trials, not fan a
+            # 4-candidate generation across submeshes
+            run_placed_trials(proposals, run_one,
+                              min(width, len(proposals)))
     return trials.argmin

@@ -242,10 +242,16 @@ def test_cv_fold_batching_matches_sequential(spark):
     # a pre-existing property of placed trials, not of fold batching
     cv = CrossValidator(estimator=rf, estimatorParamMaps=grid, evaluator=ev,
                         numFolds=3, parallelism=1, seed=11)
+    # maxFusedTrials=1 pins the FOLD-ONLY fusion shape (one vmapped
+    # program per parameter map) — the grid-fused path has its own
+    # parity + dispatch-count tests in test_dispatch_economics.py
     GLOBAL_CONF.set("sml.cv.batchFolds", True)
+    GLOBAL_CONF.set("sml.cv.maxFusedTrials", 1)
     try:
         batched = cv.fit(fdf).avgMetrics
-    finally:
         GLOBAL_CONF.set("sml.cv.batchFolds", False)
-    sequential = cv.fit(fdf).avgMetrics
+        sequential = cv.fit(fdf).avgMetrics
+    finally:
+        GLOBAL_CONF.unset("sml.cv.batchFolds")
+        GLOBAL_CONF.unset("sml.cv.maxFusedTrials")
     np.testing.assert_allclose(batched, sequential, rtol=1e-4, atol=1e-4)
