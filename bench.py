@@ -853,6 +853,131 @@ def second_fit_probe(train):
     return out
 
 
+# ------------------------------------------------------------- multichip leg
+MULTICHIP_ROWS = 200_000
+MULTICHIP_TREES = 20
+MULTICHIP_DEPTH = 6
+MULTICHIP_BINS = 32
+
+
+def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
+    """`--multichip`: the fit-throughput SCALING leg (ISSUE 6) — the same
+    bootstrap-forest fit executed on 1, 2, 4, ... device meshes over the
+    live device set, with the quantized bin matrix row-sharded per mesh
+    and every histogram merge a `psum` over the mesh's data axis.
+
+    Per width the leg records: best-of-3 warm fit seconds (compile +
+    staging paid in a warmup fit), fit throughput, speedup vs the
+    1-device mesh, the per-trace collective launch/byte counters (the
+    ICI allreduce volume one program carries — captured from the warmup
+    trace, since collectives are counted at TRACE time), and a model
+    PARITY check against the 1-device fit (sampling draws are
+    mesh-layout-invariant, so every width must produce the same forest
+    up to float reduction order).
+
+    On a 1-device host this degenerates to a single row honestly; the
+    committed MULTICHIP artifact runs it under the simulated 8-device
+    CPU mesh (`XLA_FLAGS=--xla_force_host_platform_device_count=8`),
+    where "scaling" measures the engine's dispatch structure, not real
+    ICI — real-chip numbers come from running the same flag on a pod
+    slice. Results merge into the bench sidecar as the `multichip`
+    block, rendered by scripts/render_perf.py."""
+    import jax
+
+    from sml_tpu import obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml._tree_models import _fit_ensemble
+    from sml_tpu.parallel import mesh as meshlib
+
+    n_avail = len(jax.devices())
+    widths = [w for w in (1, 2, 4, 8, 16, 32, 64) if w <= n_avail]
+    rng = np.random.default_rng(42)
+    F = 10
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    y = (X[:, 0] * 3 - X[:, 1] ** 2 + 0.5 * X[:, 2]
+         + rng.normal(0, 0.3, rows)).astype(np.float32)
+    probe = X[:4096]
+
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    entries = []
+    ref_pred = None
+    try:
+        for w in widths:
+            mesh = meshlib.build_mesh(w)
+            with meshlib.use_mesh(mesh):
+                def fit():
+                    return _fit_ensemble(
+                        X, y, categorical={}, max_depth=MULTICHIP_DEPTH,
+                        max_bins=MULTICHIP_BINS, min_instances=1,
+                        min_info_gain=0.0, n_trees=MULTICHIP_TREES,
+                        feature_k=None, bootstrap=True, subsample=1.0,
+                        seed=42, loss="squared")
+
+                obs.reset()
+                spec = fit()  # warmup: compile + bin + stage + trace
+                coll = obs.RECORDER.counters()
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    fit()
+                    best = min(best, time.perf_counter() - t0)
+                pred = spec.predict_margin(probe)
+            if ref_pred is None:
+                ref_pred = pred
+            parity = bool(np.allclose(pred, ref_pred, rtol=1e-4, atol=1e-4))
+            entries.append({
+                "devices": w,
+                "seconds": round(best, 4),
+                "rows_per_s": round(rows / best, 1),
+                "speedup_vs_1": round(entries[0]["seconds"] / best, 3)
+                if entries else 1.0,
+                "collective_psum": int(coll.get("collective.psum", 0)),
+                "collective_psum_bytes":
+                    float(coll.get("collective.psum_bytes", 0.0)),
+                "parity_vs_1": parity,
+            })
+            print(f"  multichip {w}d: {best:.3f}s "
+                  f"({rows / best:,.0f} rows/s, "
+                  f"psum {coll.get('collective.psum_bytes', 0) / 1e6:.2f} "
+                  f"MB/trace, parity={parity})", file=sys.stderr)
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
+    return {
+        "rows": rows, "n_features": F, "n_trees": MULTICHIP_TREES,
+        "max_depth": MULTICHIP_DEPTH, "max_bins": MULTICHIP_BINS,
+        "backend": jax.default_backend(), "n_devices": n_avail,
+        "note": "best-of-3 warm fits per mesh width; collective counters "
+                "are per-TRACE statics (multiply by executions for wire "
+                "traffic); parity_vs_1 = same forest as the 1-device "
+                "mesh (layout-invariant sampling)",
+        "widths": entries,
+    }
+
+
+def multichip_main(rows: int) -> None:
+    """Run the scaling leg standalone, merge the `multichip` block into
+    the bench sidecar, and print the short headline JSON last."""
+    block = run_multichip(rows)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["multichip"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    best = max(e["speedup_vs_1"] for e in block["widths"])
+    print(json.dumps({
+        "metric": "multichip fit-throughput scaling",
+        "value": best,
+        "unit": "x vs 1 device",
+        "n_devices": block["n_devices"],
+        "backend": block["backend"],
+        "parity_ok": all(e["parity_vs_1"] for e in block["widths"]),
+        "legs_file": "bench_legs.json",
+    }))
+
+
 # ----------------------------------------------------------------- goldens
 def check_goldens(metrics):
     """Compare this run's metric values against the CPU-mesh 1M-row pins
@@ -1190,6 +1315,15 @@ if __name__ == "__main__":
                              "previous run's recordings next to the compile "
                              "cache) concurrently before warmup; equivalent "
                              "to setting sml.prewarm.enabled=true")
+    parser.add_argument("--multichip", action="store_true",
+                        help="run ONLY the multi-chip fit-throughput "
+                             "scaling leg over 1..n-device meshes and "
+                             "merge the `multichip` block into the "
+                             "bench sidecar (simulate chips on CPU with "
+                             "XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=8)")
+    parser.add_argument("--multichip-rows", type=int, default=MULTICHIP_ROWS,
+                        help="row count for the --multichip leg")
     parser.add_argument("--lint", action="store_true",
                         help="gate the run on a clean graftlint pass: a "
                              "bench record from a tree violating engine "
@@ -1205,5 +1339,7 @@ if __name__ == "__main__":
         sys.exit(1)
     if args.pin_goldens:
         pin_goldens()
+    elif args.multichip:
+        multichip_main(args.multichip_rows)
     else:
         main()

@@ -150,6 +150,20 @@ _register("sml.cv.maxFusedTrials", 16, int,
           "dispatch by the grid-fused CV path (bounds the stacked "
           "operand memory to ~maxFusedTrials fold copies); <= 1 falls "
           "back to fold-only fusion (one dispatch per parameter map)")
+_register("sml.cv.trialAxisDevices", 0, int,
+          "Devices spanned by the fused-trial ELEMENT axis: grid-fused "
+          "(grid point x fold) trials shard over a second ('trial') mesh "
+          "axis while each trial lane keeps sharding rows over the "
+          "remainder — E trials progress on disjoint chips with an "
+          "n_dev/t-wide (often allreduce-free) data axis apiece, instead "
+          "of vmapping every trial onto one program spanning all chips. "
+          "0 = auto (shard trials whenever one trial's padded rows fit a "
+          "single chip comfortably — the small-rows regime where the "
+          "per-level psum latency dominates the per-chip matmul); 1 = "
+          "rows-only sharding (the pre-r6 layout); k > 1 clamps to the "
+          "largest mesh divisor <= k. Results match the rows-only layout "
+          "within float reduction-order tolerance (sampling draws are "
+          "mesh-layout-invariant)")
 _register("sml.tune.candidatesPerDispatch", 4, int,
           "TPE candidates proposed AND scored per generation for "
           "batch-capable fmin objectives (fn.score_batch): a "

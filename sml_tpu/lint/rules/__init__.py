@@ -4,4 +4,4 @@ in `core.RULES`; add a new rule by dropping a module here that uses the
 docs/LINTING.md "Adding a rule")."""
 
 from . import (conf_keys, dispatch_bypass, donation,  # noqa: F401
-               host_sync, taxonomy, wallclock)
+               host_sync, sharded_staging, taxonomy, wallclock)

@@ -1,0 +1,118 @@
+"""Rule 7 — unsharded-device-put.
+
+The multi-chip execution mode is only real if staged operands actually
+SHARD: a `jax.device_put(x)` with no sharding argument inside a staging
+path places the whole array on ONE device (jax's default-device
+semantics), silently turning "per-device partial histograms + psum over
+ICI" into single-chip execution with 7 idle chips — and nothing fails,
+it is just not distributed. Every staging-path put must carry an
+explicit placement: `meshlib.data_sharding(...)`, a `NamedSharding`, or
+the blessed replicated spec.
+
+Scope — "staging paths": functions in a module whose filename contains
+``_staging``, plus any function named ``stage_*`` / ``shard_*`` anywhere
+in the tree (the staging helpers `parallel/mesh.py` exports). Calls
+elsewhere (dispatch calibration probes, test utilities) are out of
+scope: placing a probe on one device is correct there.
+
+Accepted second arguments: a call whose target name is
+``data_sharding`` / ``replicated`` / ``NamedSharding`` (any attribute
+spelling, e.g. ``meshlib.data_sharding`` or
+``jax.sharding.NamedSharding``), or a NAME bound earlier in the function
+from such a call (the `spec = ...; jax.device_put(a, spec)` idiom).
+Everything else — no second argument, a bare device, an unrecognized
+expression — is flagged; the pragma/baseline machinery applies as for
+every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Violation, rule
+from ..project import Project
+
+SHARDING_CALLS = {"data_sharding", "replicated", "NamedSharding"}
+STAGING_FN_PREFIXES = ("stage_", "shard_")
+STAGING_FILE_MARK = "_staging"
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_sharding_expr(e: ast.expr, bound: Set[str]) -> bool:
+    if isinstance(e, ast.Call):
+        return _call_name(e.func) in SHARDING_CALLS
+    if isinstance(e, ast.Name):
+        return e.id in bound
+    return False
+
+
+def _sharding_bound_names(fn_node: ast.AST) -> Set[str]:
+    """Names assigned from a sharding-constructor call anywhere in the
+    function (linear scan is enough: the rule is a structure check, not
+    a dataflow proof — a rebind to a non-sharding value still places the
+    array somewhere explicit)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _call_name(node.value.func) in SHARDING_CALLS:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _in_scope(rel: str, qualname: str) -> bool:
+    fname = rel.rsplit("/", 1)[-1]
+    if STAGING_FILE_MARK in fname:
+        return True
+    leaf = qualname.rsplit(".", 1)[-1]
+    return leaf.startswith(STAGING_FN_PREFIXES)
+
+
+@rule("unsharded-device-put",
+      "jax.device_put in staging paths must place through "
+      "meshlib.data_sharding / NamedSharding (an unsharded put lands the "
+      "whole array on one device and silently de-distributes the mesh)")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, fns in project.function_index().items():
+        for fn in fns:
+            if not _in_scope(rel, fn.qualname):
+                continue
+            bound = _sharding_bound_names(fn.node)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                is_put = (isinstance(f, ast.Attribute)
+                          and f.attr == "device_put") \
+                    or (isinstance(f, ast.Name) and f.id == "device_put")
+                if not is_put:
+                    continue
+                # the placement may ride positionally or as the
+                # documented `device=` keyword — both count
+                shard_arg = node.args[1] if len(node.args) >= 2 else None
+                if shard_arg is None:
+                    for kw in node.keywords:
+                        if kw.arg == "device":
+                            shard_arg = kw.value
+                            break
+                if shard_arg is not None \
+                        and _is_sharding_expr(shard_arg, bound):
+                    continue
+                out.append(Violation(
+                    "unsharded-device-put", rel, node.lineno,
+                    f"`jax.device_put` without an explicit mesh sharding "
+                    f"inside staging path `{fn.qualname}` — pass "
+                    f"meshlib.data_sharding(...) / NamedSharding(...) so "
+                    f"the operand actually shards over the mesh instead "
+                    f"of landing whole on one device"))
+    return out

@@ -331,6 +331,32 @@ def stage_stacked_cached(a: np.ndarray) -> jax.Array:
     return hit
 
 
+def stage_trial_stacked_cached(a: np.ndarray, mesh) -> jax.Array:
+    """device_put an ELEMENT-STACKED array (elems, rows, ...) through the
+    content cache onto a 2-D trial mesh (`meshlib.trial_mesh`): trial
+    elements shard over TRIAL_AXIS, rows over DATA_AXIS — the resident
+    layout of cross-chip trial parallelism. The caller pre-pads axis 0 to
+    a multiple of the trial dim and axis 1 to a multiple of the FULL
+    device count (so any data-axis width divides it)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    a = _normalize(a)
+    key = (_memo_key(a), id(mesh), "tstack",
+           mesh.shape[meshlib.TRIAL_AXIS], mesh.shape[meshlib.DATA_AXIS])
+    hit = _stage_cache.get(key)
+    from ..utils.profiler import PROFILER
+    if hit is None:
+        spec = P(meshlib.TRIAL_AXIS, meshlib.DATA_AXIS,
+                 *([None] * (a.ndim - 2)))
+        hit = jax.device_put(a, NamedSharding(mesh, spec))
+        _cache_put(key, hit)
+        PROFILER.count("staging.cache_miss")
+        PROFILER.count("staging.h2d_bytes", a.nbytes)
+    else:
+        PROFILER.count("staging.cache_hit")
+        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
+    return hit
+
+
 def stage_mask_cached(n_padded: int, n_true: int) -> jax.Array:
     mesh = meshlib.get_mesh()
     mkey = (n_padded, n_true, id(mesh), "mask", mesh.shape[meshlib.DATA_AXIS])

@@ -315,6 +315,13 @@ def _fit_ensembles_grid(Xs, ys, cats, trials, max_fused: int,
     (fold, maxBins): a grid over maxBins legitimately re-quantizes,
     everything else reuses the fold's cached matrices.
 
+    On a multi-device mesh the fused elements may SHARD across a second
+    "trial" mesh axis instead of all-replicating (cross-chip trial
+    parallelism — `sml.cv.trialAxisDevices` /
+    `tree_impl._trial_axis_width` decide placement inside
+    `fit_ensembles_trials`); dispatch counts and results are unchanged
+    up to float reduction order.
+
     Returns {(grid_index, fold_index): _EnsembleSpec}."""
     import jax
 

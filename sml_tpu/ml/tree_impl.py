@@ -478,12 +478,31 @@ def _base_margin_fn(loss: str):
     return base_fn
 
 
-def _ensemble_pieces(es: EnsembleSpec):
+def _sliced_draw(n: int, data_width: int, draw):
+    """Mesh-layout-INVARIANT sampling weights: every chip draws the FULL
+    padded row space (`n * data_width` values — counter-based threefry,
+    a few cheap VPU passes next to the histogram matmuls) from the same
+    replicated key and slices out its own row block, so the selected
+    weights are bit-identical to the single-device draw no matter how
+    rows shard. Before r6 each chip folded its shard index into the key,
+    which made every bootstrap forest a function of the mesh LAYOUT —
+    adding chips silently changed the fitted model, and an 8-chip fit
+    could never golden-match a 1-chip fit."""
+    if data_width <= 1:
+        return draw((n,))
+    full = draw((n * data_width,))
+    return jax.lax.dynamic_slice(full, (coll.axis_index() * n,), (n,))
+
+
+def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1):
     """The shared internals of every ensemble program shape: `prepare`
     widens the compact quantized bins on-device and hoists the one-hot
     transpose; `make_round` returns the per-round scan body. Factored so
     the monolithic program and the chunked boosting program are the SAME
-    math — a parity test holds them together."""
+    math — a parity test holds them together. `data_width` is the mesh's
+    STATIC data-axis size (part of every program cache's mesh-id key):
+    sampling draws span `local_rows * data_width` so every layout selects
+    the same global weights (see `_sliced_draw`)."""
     spec = es.tree
     hist_dtype = _hist_dtype()
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
@@ -496,9 +515,10 @@ def _ensemble_pieces(es: EnsembleSpec):
         binned = binned.astype(jnp.int32)
         B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
             .reshape(n, F * B).T  # transposed ONCE, reused by every tree
-        # per-chip sampling streams must differ: fold in the shard index
-        key = jax.random.fold_in(jax.random.wrap_key_data(rng),
-                                 coll.axis_index())
+        # ONE replicated sampling stream (fold_in(0) preserves the
+        # historical single-device draws bit-for-bit); per-chip weights
+        # come from slicing the global draw, not from per-chip keys
+        key = jax.random.fold_in(jax.random.wrap_key_data(rng), 0)
         return binned, B1t, key
 
     def make_round(binned, B1t, y, mask, key, rng):
@@ -518,9 +538,11 @@ def _ensemble_pieces(es: EnsembleSpec):
                 hess = jnp.ones_like(y)
             kt = jax.random.fold_in(key, t)
             if es.bootstrap and es.n_trees > 1:
-                w = jax.random.poisson(kt, es.subsample, (n,)).astype(jnp.float32)
+                w = _sliced_draw(n, data_width, lambda s: jax.random.poisson(
+                    kt, es.subsample, s).astype(jnp.float32))
             elif es.subsample < 1.0:
-                w = jax.random.bernoulli(kt, es.subsample, (n,)).astype(jnp.float32)
+                w = _sliced_draw(n, data_width, lambda s: jax.random.bernoulli(
+                    kt, es.subsample, s).astype(jnp.float32))
             else:
                 w = jnp.ones((n,), jnp.float32)
             w = w * mask
@@ -538,12 +560,20 @@ def _ensemble_pieces(es: EnsembleSpec):
     return prepare, make_round
 
 
-def _make_ensemble_program(es: EnsembleSpec):
+def _data_width(mesh=None) -> int:
+    """The mesh's static data-axis size — the sampling-slice factor every
+    program maker threads into `_ensemble_pieces` (programs cache per
+    mesh id, so the width is as static as the mesh)."""
+    mesh = mesh or meshlib.get_mesh()
+    return int(mesh.shape.get(meshlib.DATA_AXIS, 1))
+
+
+def _make_ensemble_program(es: EnsembleSpec, data_width: int = 1):
     """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
     trees, margins and sampling weights living in HBM for the entire fit.
     One dispatch + one packed device→host transfer per ensemble — the
     per-tree host round-trips (expensive over a TPU tunnel) disappear."""
-    prepare, make_round = _ensemble_pieces(es)
+    prepare, make_round = _ensemble_pieces(es, data_width)
     base_of = _base_margin_fn(es.loss)
 
     def program(binned, y, mask, rng):
@@ -557,12 +587,12 @@ def _make_ensemble_program(es: EnsembleSpec):
     return program
 
 
-def _make_chunk_program(es: EnsembleSpec, chunk: int):
+def _make_chunk_program(es: EnsembleSpec, chunk: int, data_width: int = 1):
     """`chunk` boosting rounds as one dispatch: the margin carry enters and
     leaves as a row-sharded HBM buffer (donated between dispatches by the
     caller), `t0` offsets the round index so sampling streams and feature
     subspaces match the monolithic scan round-for-round."""
-    prepare, make_round = _ensemble_pieces(es)
+    prepare, make_round = _ensemble_pieces(es, data_width)
 
     def program(binned, y, mask, margin, rng, t0):
         binned, B1t, key = prepare(binned, rng)
@@ -595,7 +625,7 @@ def _compiled_chunk(es: EnsembleSpec, chunk: int):
     if key not in _chunk_cache:
         from ..obs import note_compile
         note_compile(f"tree_chunk_{chunk}")
-        program = _make_chunk_program(es, chunk)
+        program = _make_chunk_program(es, chunk, _data_width(mesh))
         P = jax.sharding.PartitionSpec
         Dx = _meshlib.DATA_AXIS
         wrapped = _meshlib.shard_map_compat(
@@ -674,8 +704,9 @@ def _ensemble_compiled(es: EnsembleSpec):
     if key not in _ensemble_cache:
         from ..obs import note_compile
         note_compile("tree_ensemble")
-        _ensemble_cache[key] = data_parallel(_make_ensemble_program(es),
-                                             replicated_argnums=(3,))
+        _ensemble_cache[key] = data_parallel(
+            _make_ensemble_program(es, _data_width()),
+            replicated_argnums=(3,))
     return _ensemble_cache[key]
 
 
@@ -809,7 +840,7 @@ def _folds_compiled(es: EnsembleSpec, fo: int):
     if key not in _folds_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_folds_{fo}")
-        program = _make_ensemble_program(es)
+        program = _make_ensemble_program(es, _data_width(mesh))
 
         def batched(binned_f, y_f, mask_f, rng):
             return jax.vmap(program, in_axes=(0, 0, 0, None))(
@@ -829,14 +860,17 @@ def _folds_compiled(es: EnsembleSpec, fo: int):
 _trials_cache: Dict[tuple, object] = {}
 
 
-def _make_trials_program(es: EnsembleSpec):
+def _make_trials_program(es: EnsembleSpec, data_width: int = 1):
     """Per-ELEMENT ensemble program with TRACED hyperparameters, vmapped
     over the trial axis by `fit_ensembles_trials`: `es` carries the grid
     MAXIMA as static shapes (max_depth, n_bins, n_trees), and each
     element's `TrialDyn` + sampling flags gate the build down to its own
     hyperparameters. Sampling weights select among poisson / bernoulli /
-    ones draws from the SAME keys the per-trial static programs use, so
-    the selected values match the unfused path draw-for-draw."""
+    ones draws from the SAME keys the per-trial static programs use —
+    and through the same layout-invariant global-draw-then-slice
+    (`_sliced_draw`), so the selected values match the unfused path
+    draw-for-draw on ANY mesh layout (including the cross-chip
+    trial-sharded one, whose data axis is only n_dev/trial_dim wide)."""
     spec = es.tree
     hist_dtype = _hist_dtype()
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
@@ -849,8 +883,7 @@ def _make_trials_program(es: EnsembleSpec):
         binned = binned.astype(jnp.int32)
         B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
             .reshape(n, F * B).T
-        key = jax.random.fold_in(jax.random.wrap_key_data(rng),
-                                 coll.axis_index())
+        key = jax.random.fold_in(jax.random.wrap_key_data(rng), 0)
         base = base_of(y, mask)
         dyn = TrialDyn(depth=depth, feature_k=feature_k,
                        min_instances=min_inst, min_info_gain=mig)
@@ -859,10 +892,10 @@ def _make_trials_program(es: EnsembleSpec):
             grad = -y
             hess = jnp.ones_like(y)
             kt = jax.random.fold_in(key, t)
-            pois = jax.random.poisson(kt, subsample, (n,)) \
-                .astype(jnp.float32)
-            bern = jax.random.bernoulli(kt, subsample, (n,)) \
-                .astype(jnp.float32)
+            pois = _sliced_draw(n, data_width, lambda s: jax.random.poisson(
+                kt, subsample, s).astype(jnp.float32))
+            bern = _sliced_draw(n, data_width, lambda s: jax.random.bernoulli(
+                kt, subsample, s).astype(jnp.float32))
             ones = jnp.ones((n,), jnp.float32)
             w = jnp.where(bootstrap, pois,
                           jnp.where(subsample < 1.0, bern, ones)) * mask
@@ -877,17 +910,21 @@ def _make_trials_program(es: EnsembleSpec):
     return program
 
 
-def _trials_compiled(es: EnsembleSpec, n_elems: int):
+def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None):
     """The trial-batched program from its per-mesh cache (shared with the
     prewarm rebuilder). Cache key carries only STATIC maxima — a grid
     whose per-trial values change but whose maxima land on the same
-    (depth, bins, trees) signature replays one executable."""
-    mesh = meshlib.get_mesh()
+    (depth, bins, trees) signature replays one executable. `mesh` may be
+    a 2-D trial mesh (`meshlib.trial_mesh`): the element axis then SHARDS
+    over TRIAL_AXIS (cross-chip trial parallelism) instead of
+    replicating, and each trial lane's histogram psums span only its own
+    n_dev/trial_dim-wide data axis."""
+    mesh = mesh or meshlib.get_mesh()
     key = (es, n_elems, id(mesh), _hist_subtract())
     if key not in _trials_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_trials_{n_elems}")
-        program = _make_trials_program(es)
+        program = _make_trials_program(es, _data_width(mesh))
 
         def batched(binned_e, y_e, mask_e, rngs, *dyns):
             return jax.vmap(program,
@@ -896,13 +933,76 @@ def _trials_compiled(es: EnsembleSpec, n_elems: int):
 
         P = jax.sharding.PartitionSpec
         D = meshlib.DATA_AXIS
+        T = meshlib.TRIAL_AXIS
+        if T in mesh.shape:
+            in_specs = (P(T, D, None), P(T, D), P(T, D), P(T, None)) \
+                + (P(T),) * 6
+            out_specs = (P(T), P(T))
+        else:
+            in_specs = (P(None, D, None), P(None, D), P(None, D)) \
+                + (P(),) * 7
+            out_specs = (P(), P())
         wrapped = meshlib.shard_map_compat(
-            batched, mesh=mesh,
-            in_specs=(P(None, D, None), P(None, D), P(None, D))
-            + (P(),) * 7,
-            out_specs=(P(), P()))
+            batched, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         _trials_cache[key] = jax.jit(wrapped)
     return _trials_cache[key]
+
+
+#: auto trial-sharding threshold: one trial's padded rows below this fit
+#: a single chip's compute comfortably (the dispatch cost model's
+#: small-rows regime, where the per-level psum's fixed ICI latency
+#: rivals the per-chip histogram matmul it synchronizes)
+_TRIAL_SHARD_MAX_ROWS = 1 << 18
+
+
+def _trial_axis_width(E: int, n_pad: int) -> int:
+    """Devices the fused-trial ELEMENT axis spans; the rest keep sharding
+    rows. `sml.cv.trialAxisDevices`: 0 = auto, 1 = rows-only, k > 1 =
+    the largest mesh divisor <= k (honored even when E % k != 0 — the
+    element axis pads by repeating element 0, `_pad_elems`). Auto
+    mirrors the `dispatch.decide` trade (WorkHint pricing of compute vs
+    the fixed per-collective latency term): small per-trial row counts
+    gain nothing from splitting rows across every chip but pay
+    D-levels × n_trees of allreduce latency per trial, so trials spread
+    across chips instead — each lane's data axis shrinks (to 1 at full
+    width: allreduce-free trials). Auto never pads: among the divisors
+    of E it picks the largest (wall-clock per dispatch scales with
+    ceil(E/t)*t, so padded elements are pure waste absent an explicit
+    user choice)."""
+    from ..conf import GLOBAL_CONF
+    mesh = meshlib.get_mesh()
+    if tuple(mesh.axis_names) != (meshlib.DATA_AXIS,):
+        return 1  # placed submeshes / 2-D dryrun meshes keep row layout
+    n_dev = int(mesh.shape[meshlib.DATA_AXIS])
+    if n_dev <= 1 or E <= 1:
+        return 1
+    conf = GLOBAL_CONF.getInt("sml.cv.trialAxisDevices")
+    if conf == 1:
+        return 1
+    if conf <= 0 and n_pad > _TRIAL_SHARD_MAX_ROWS:
+        return 1  # big rows: per-chip row blocks already feed the MXU
+    cap = n_dev if conf <= 0 else min(conf, n_dev)
+    divisors = [d for d in range(2, cap + 1) if n_dev % d == 0]
+    if conf > 1:
+        return max(divisors, default=1)
+    best, best_pad = 1, E
+    for d in divisors:
+        if d > E:
+            continue
+        pad = -(-E // d) * d
+        if pad < best_pad or (pad == best_pad and d > best):
+            best, best_pad = d, pad
+    return best
+
+
+def _pad_elems(a: np.ndarray, e_pad: int) -> np.ndarray:
+    """Pad the element axis by REPEATING element 0 (real rows, real
+    hyperparameters — never an all-masked element whose base margin would
+    divide by a zero row count); the caller slices the duplicates away."""
+    if a.shape[0] == e_pad:
+        return a
+    reps = np.repeat(a[:1], e_pad - a.shape[0], axis=0)
+    return np.concatenate([a, reps], axis=0)
 
 
 def fit_ensembles_trials(bst, yst, mst, es: EnsembleSpec, rngs,
@@ -913,38 +1013,60 @@ def fit_ensembles_trials(bst, yst, mst, es: EnsembleSpec, rngs,
     per-trial hyperparameters ride as traced (E,)-vectors (padded to the
     grid maxima carried statically by `es`), so a G-point grid over k
     folds is ceil(G*k / sml.cv.maxFusedTrials) dispatches instead of G*k
-    (or G). Rows shard over the data axis; the element axis is
-    replicated, exactly like the fold axis in the fold-only program.
+    (or G).
+
+    Placement (`sml.cv.trialAxisDevices`, see `_trial_axis_width`): on a
+    multi-device 1-D data mesh the element axis can SHARD over a second
+    ("trial") mesh axis — E trials run on disjoint chip groups, each
+    lane's rows sharded over its own (often width-1 = allreduce-free)
+    data axis — instead of vmapping every trial onto one program spanning
+    all chips. Sampling draws are layout-invariant (`_sliced_draw`), so
+    both placements produce the same models up to float reduction order.
+    Width 1 keeps the classic layout: rows over the data axis, element
+    axis replicated, exactly like the fold axis in the fold-only program.
 
     Returns the raw (E, n_trees, 5, n_nodes) pack stack + (E,) bases —
     the caller slices each element down to its own numTrees."""
     from ..parallel import dispatch as _dispatch
     from ..parallel import prewarm as _prewarm
     from ..utils.profiler import PROFILER
-    from ._staging import stage_stacked_cached
+    from ._staging import stage_stacked_cached, stage_trial_stacked_cached
 
     mesh = meshlib.get_mesh()
     E, n_pad = bst.shape[0], bst.shape[1]
-    b_dev = stage_stacked_cached(bst)
-    y_dev = stage_stacked_cached(yst)
-    m_dev = stage_stacked_cached(mst)
-    compiled = _trials_compiled(es, E)
-    _prewarm.record("tree_trials", {
-        "es": _es_meta(es), "n_elems": int(E),
-        "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
-    with PROFILER.span(
-            "program.tree_ensemble_trials", rows=int(E * n_pad),
-            route="host" if _dispatch.is_host_mesh(mesh) else "device",
-            trees=es.n_trees * E):
-        PROFILER.count("tree.fit_dispatch")
-        packs, bases = jax.device_get(compiled(
-            b_dev, y_dev, m_dev, np.asarray(rngs),
-            np.asarray(depth, np.int32), np.asarray(feature_k, np.int32),
+    tdim = _trial_axis_width(E, n_pad)
+    dyns = [np.asarray(depth, np.int32), np.asarray(feature_k, np.int32),
             np.asarray(min_inst, np.float32),
             np.asarray(min_gain, np.float32),
-            np.asarray(bootstrap, bool),
-            np.asarray(subsample, np.float32)))
-    return packs, bases
+            np.asarray(bootstrap, bool), np.asarray(subsample, np.float32)]
+    rngs = np.asarray(rngs)
+    if tdim > 1:
+        e_pad = -(-E // tdim) * tdim
+        tmesh = meshlib.trial_mesh(tdim, mesh)
+        bst, yst, mst = (_pad_elems(a, e_pad) for a in (bst, yst, mst))
+        rngs = _pad_elems(rngs, e_pad)
+        dyns = [_pad_elems(v, e_pad) for v in dyns]
+        b_dev = stage_trial_stacked_cached(bst, tmesh)
+        y_dev = stage_trial_stacked_cached(yst, tmesh)
+        m_dev = stage_trial_stacked_cached(mst, tmesh)
+        compiled = _trials_compiled(es, e_pad, tmesh)
+    else:
+        e_pad = E
+        b_dev = stage_stacked_cached(bst)
+        y_dev = stage_stacked_cached(yst)
+        m_dev = stage_stacked_cached(mst)
+        compiled = _trials_compiled(es, E)
+    _prewarm.record("tree_trials", {
+        "es": _es_meta(es), "n_elems": int(e_pad), "trial_dim": int(tdim),
+        "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
+    with PROFILER.span(
+            "program.tree_ensemble_trials", rows=int(e_pad * n_pad),
+            route="host" if _dispatch.is_host_mesh(mesh) else "device",
+            trees=es.n_trees * e_pad):
+        PROFILER.count("tree.fit_dispatch")
+        packs, bases = jax.device_get(compiled(
+            b_dev, y_dev, m_dev, rngs, *dyns))
+    return packs[:E], bases[:E]
 
 
 # ------------------------------------------------------- prewarm rebuilders
@@ -1014,9 +1136,26 @@ def _replay_tree_folds(meta: dict) -> None:
 def _replay_tree_trials(meta: dict) -> None:
     es = _es_from_meta(meta)
     E = int(meta["n_elems"])
-    b, y, m = _replay_zeros(meta, 3)
+    tdim = int(meta.get("trial_dim", 1))
+    if tdim > 1:
+        # trial-sharded variant: rebuild the 2-D mesh over the live data
+        # mesh's devices and place operands exactly like the fit path
+        tmesh = meshlib.trial_mesh(tdim)
+        P = jax.sharding.PartitionSpec
+        arrs = []
+        for shape, dtype in meta["args"][:3]:
+            a = np.zeros(tuple(shape), dtype=np.dtype(dtype))
+            spec = P(meshlib.TRIAL_AXIS, meshlib.DATA_AXIS,
+                     *([None] * (a.ndim - 2)))
+            arrs.append(jax.device_put(
+                a, jax.sharding.NamedSharding(tmesh, spec)))
+        b, y, m = arrs
+        compiled = _trials_compiled(es, E, tmesh)
+    else:
+        b, y, m = _replay_zeros(meta, 3)
+        compiled = _trials_compiled(es, E)
     rngs = np.zeros((E, 2), np.uint32)
-    jax.device_get(_trials_compiled(es, E)(
+    jax.device_get(compiled(
         b, y, m, rngs,
         np.full(E, es.tree.max_depth, np.int32),
         np.full(E, es.tree.n_features, np.int32),

@@ -74,7 +74,11 @@ def _batched_fold_metrics(est, grid, fold_pairs, evaluator):
     hyperparameters pad to the grid maxima and ride as traced scalars,
     so the dispatch count stops scaling with the grid. With
     sml.cv.maxFusedTrials <= 1 only the fold axis fuses (the VERDICT r3
-    per-parameter-map `fit_ensembles_folds` shape: G dispatches).
+    per-parameter-map `fit_ensembles_folds` shape: G dispatches). On a
+    multi-device mesh the fused elements shard across a second "trial"
+    mesh axis when that placement prices better
+    (sml.cv.trialAxisDevices; see tree_impl._trial_axis_width) — E
+    trials on disjoint chip groups instead of one all-chip vmap.
     Returns the (len(grid), k) metric matrix, or None whenever the shape
     doesn't apply (non-tree estimator, grid touching data-shaping
     params, sml.cv.batchFolds=false, or any surprise) — the caller then

@@ -47,7 +47,13 @@ COUNTERS = {
     # replayed/failed first-dispatches, pool-size attribution
     "prewarm.*",
     "dispatch.route_*",   # dispatch.route_host / dispatch.route_device
-    "collective.*",       # per-trace collective launch counts
+    "collective.*",       # per-trace collective launch counts PLUS the
+                          # per-op payload-byte counters
+                          # (collective.psum_bytes / pmean_bytes / ...):
+                          # one launch's ICI allreduce volume, recorded at
+                          # trace time from the operand's static shape —
+                          # the *_bytes suffix puts them on the trace
+                          # exporter's counter tracks
     # serving layer (sml_tpu/serving): request admission, micro-batch
     # dispatches, degradation ladder, model cache, canary mirror
     "serve.requests", "serve.rows",

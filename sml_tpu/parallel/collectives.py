@@ -25,20 +25,43 @@ from ..obs._recorder import RECORDER as _OBS
 from .mesh import DATA_AXIS
 
 
-def _note(op: str) -> None:
+def _payload_bytes(x) -> float:
+    """Per-launch payload of one collective operand: every participating
+    chip moves (shape x itemsize) bytes through the allreduce/gather ring.
+    Computed from the TRACE-time abstract value (shapes are static), so it
+    works on tracers and concrete arrays alike."""
+    import numpy as _np
+    try:
+        dt = _np.dtype(getattr(x, "dtype", _np.float32))
+    except TypeError:
+        dt = _np.dtype(_np.float32)
+    return float(_np.prod(_np.shape(x), dtype=_np.float64) * dt.itemsize)
+
+
+def _note(op: str, x=None) -> None:
     """Flight-recorder collective event. These wrappers execute at TRACE
     time (the collective itself runs inside the compiled program), so one
     event marks one collective launch PER COMPILED PROGRAM — the static
     count a graph runtime can know without a device profiler; multiply by
-    program executions for wire traffic. No-op when the recorder is off."""
+    program executions for wire traffic. No-op when the recorder is off.
+
+    With an operand `x`, the per-launch payload is counted into
+    `collective.<op>_bytes` (rendered as a counter track by the trace
+    exporter): the ICI allreduce volume of one split round is the
+    histogram payload, and the histogram-subtraction trick's halving of
+    it is directly visible in this counter."""
     if _OBS.enabled:
-        _OBS.emit("collective", f"collective.{op}")
+        nbytes = None if x is None else _payload_bytes(x)
+        _OBS.emit("collective", f"collective.{op}",
+                  args=None if nbytes is None else {"bytes": nbytes})
         _OBS.counter(f"collective.{op}")
+        if nbytes:
+            _OBS.counter(f"collective.{op}_bytes", nbytes)
 
 
 def psum(x, axis: str = DATA_AXIS):
     """Allreduce-sum over the mesh axis — the `treeAggregate` replacement."""
-    _note("psum")
+    _note("psum", x)
     return lax.psum(x, axis_name=axis)
 
 
@@ -53,38 +76,38 @@ def psum_scalars(*xs, axis: str = DATA_AXIS):
 
 
 def pmean(x, axis: str = DATA_AXIS):
-    _note("pmean")
+    _note("pmean", x)
     return lax.pmean(x, axis_name=axis)
 
 
 def pmax(x, axis: str = DATA_AXIS):
-    _note("pmax")
+    _note("pmax", x)
     return lax.pmax(x, axis_name=axis)
 
 
 def pmin(x, axis: str = DATA_AXIS):
-    _note("pmin")
+    _note("pmin", x)
     return lax.pmin(x, axis_name=axis)
 
 
 def all_gather(x, axis: str = DATA_AXIS, *, tiled: bool = False):
-    _note("all_gather")
+    _note("all_gather", x)
     return lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = DATA_AXIS, *, scatter_dimension: int = 0):
-    _note("reduce_scatter")
+    _note("reduce_scatter", x)
     return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dimension, tiled=True)
 
 
 def all_to_all(x, axis: str = DATA_AXIS, *, split_axis: int = 0, concat_axis: int = 0):
     """Device-side shuffle: exchange row blocks between chips over ICI."""
-    _note("all_to_all")
+    _note("all_to_all", x)
     return lax.all_to_all(x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
 def ppermute(x, perm, axis: str = DATA_AXIS):
-    _note("ppermute")
+    _note("ppermute", x)
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 

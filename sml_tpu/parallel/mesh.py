@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"    # row / batch parallelism (Spark partitions → chips)
 MODEL_AXIS = "model"  # feature/block parallelism (Gram blocks, ALS factors)
+TRIAL_AXIS = "trial"  # fused (grid point × fold) trial parallelism
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs):
@@ -149,6 +150,33 @@ def submeshes(k: int, mesh: Optional[Mesh] = None) -> list:
         _submesh_cache[key] = out
     cached = _submesh_cache[key]
     return [cached[i % groups] for i in range(k)]
+
+
+_trial_mesh_cache: dict = {}
+
+
+def trial_mesh(trial_dim: int, mesh: Optional[Mesh] = None) -> Mesh:
+    """A 2-D ``("trial", "data")`` mesh over the SAME devices as the given
+    (or active) 1-D data mesh: fused (grid point × fold) trial ELEMENTS
+    shard over the leading axis while each trial lane keeps sharding its
+    rows over the remaining devices — cross-chip trial parallelism
+    (SURVEY §2.2 P6 re-expressed as a mesh axis instead of a thread pool).
+    ``trial_dim`` must divide the device count. Memoized per (devices,
+    trial_dim) so repeated fused grids reuse identical Mesh objects and
+    hit the per-mesh program caches instead of recompiling."""
+    mesh = mesh or get_mesh()
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    trial_dim = max(1, int(trial_dim))
+    if n % trial_dim:
+        raise ValueError(f"trial axis {trial_dim} does not divide the "
+                         f"{n}-device mesh")
+    key = (tuple(id(d) for d in devices), trial_dim)
+    if key not in _trial_mesh_cache:
+        _trial_mesh_cache[key] = Mesh(
+            np.asarray(devices).reshape(trial_dim, n // trial_dim),
+            (TRIAL_AXIS, DATA_AXIS))
+    return _trial_mesh_cache[key]
 
 
 def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:

@@ -184,13 +184,20 @@ def test_course_stated_orderings(metrics):
 
 
 def _regen():
+    # preserve foreign top-level blocks (bench_metrics_1m is written by
+    # `python bench.py --pin-goldens`, not by this regen)
+    doc = {}
+    if os.path.exists(GOLDEN_PATH):
+        with open(GOLDEN_PATH) as f:
+            doc = json.load(f)
+    doc.update({"n_rows": N_ROWS, "seed": 42,
+                "environment": "virtual 8-device CPU mesh (f32 "
+                               "histograms); the TPU bench uses bf16 "
+                               "histogram operands and reports its own "
+                               "metric values in BENCH_r*.json",
+                "metrics": compute_metrics()})
     with open(GOLDEN_PATH, "w") as f:
-        json.dump({"n_rows": N_ROWS, "seed": 42,
-                   "environment": "virtual 8-device CPU mesh (f32 "
-                                  "histograms); the TPU bench uses bf16 "
-                                  "histogram operands and reports its own "
-                                  "metric values in BENCH_r*.json",
-                   "metrics": compute_metrics()}, f, indent=1)
+        json.dump(doc, f, indent=1)
     print(f"wrote {os.path.abspath(GOLDEN_PATH)}")
 
 

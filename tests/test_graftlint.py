@@ -341,10 +341,54 @@ def test_partial_rule_run_skips_foreign_suppression_hygiene(lint):
     assert rep2.clean and rep2.n_suppressed_pragma == 1
 
 
+# ------------------------------------------- rule 7: unsharded-device-put
+SHARD = ["unsharded-device-put"]
+
+
+def test_unsharded_put_flagged_in_staging_module(lint):
+    rep = run_on(lint, {"sml_tpu/ml/_staging.py": (
+        "def stage_rows(a):\n"
+        "    return jax.device_put(a)\n")}, rules=SHARD)
+    assert rules_fired(rep) == SHARD
+    assert "data_sharding" in rep.violations[0].message
+
+
+def test_unsharded_put_flagged_for_stage_fn_with_device_arg(lint):
+    # a bare device as the second arg is still single-device placement
+    rep = run_on(lint, {"sml_tpu/parallel/util.py": (
+        "def stage_block(a, dev):\n"
+        "    return jax.device_put(a, dev)\n")}, rules=SHARD)
+    assert len(rep.violations) == 1
+
+
+def test_sharded_puts_and_out_of_scope_calls_clean(lint):
+    rep = run_on(lint, {"sml_tpu/ml/_staging.py": (
+        "def stage_rows(a, mesh):\n"
+        "    spec = NamedSharding(mesh, P('data'))\n"
+        "    x = jax.device_put(a, meshlib.data_sharding(mesh, 2))\n"
+        "    y = jax.device_put(a, spec)\n"
+        "    z = jax.device_put(a, device=meshlib.data_sharding(mesh, 1))\n"
+        "    return x, y, z\n"),
+        "sml_tpu/parallel/dispatch.py": (
+        "def calibrate(blk, dev):\n"
+        "    return jax.device_put(blk, dev)\n")}, rules=SHARD)
+    assert rep.clean
+
+
+def test_unsharded_put_pragma_suppresses(lint):
+    rep = run_on(lint, {"sml_tpu/ml/_staging.py": (
+        "def stage_probe(a):\n"
+        "    # graftlint: disable=unsharded-device-put -- single-device"
+        " probe by design\n"
+        "    return jax.device_put(a)\n")}, rules=SHARD)
+    assert rep.clean and rep.n_suppressed_pragma == 1
+
+
 # ------------------------------------------------------------ the live tree
 EXPECTED_RULES = {"host-sync-in-hot-path", "dispatch-bypass",
                   "conf-key-registry", "donation-after-use",
-                  "obs-taxonomy", "no-wallclock-in-engine"}
+                  "obs-taxonomy", "no-wallclock-in-engine",
+                  "unsharded-device-put"}
 
 
 def test_live_tree_clean_modulo_baseline(lint):
