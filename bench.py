@@ -238,12 +238,21 @@ def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
     compiled-program-over-many-small-requests story, measured. Feature
     prep happens OUTSIDE the timed region on both sides (an online
     endpoint scores feature blocks); the timed region is admission →
-    coalesce → device dispatch → per-request split."""
+    coalesce → device dispatch → per-request split.
+
+    Latency percentiles come from the engine's OWN streaming metrics
+    core (`obs.METRICS` `serve.request_ms`, fed by the micro-batcher at
+    result time — docs/OBSERVABILITY.md): log-bucketed quantiles exact
+    to one ~9% bucket, no raw sample lists, no sort. The leg also
+    records the SLO burn-rate (`sml.serve.sloMillis`) from the same
+    histogram — the number `obs.engine_health()` serves live."""
     import threading
 
+    from sml_tpu import obs
+    from sml_tpu.conf import GLOBAL_CONF as _SCONF
     from sml_tpu.ml import DeviceScorer
     from sml_tpu.serving import MicroBatcher
-    from sml_tpu.utils.profiler import PROFILER, now
+    from sml_tpu.utils.profiler import PROFILER
 
     from sml_tpu.serving import RequestShed
 
@@ -264,37 +273,45 @@ def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
     for rows in warm:
         scorer.score_block(np.ascontiguousarray(X[:rows]))
     c0 = PROFILER.counters()
-    lat = [[] for _ in range(SERVE_CLIENTS)]
     next_req = [0]
     req_lock = threading.Lock()
 
-    def client(ci, batcher):
+    def client(batcher):
         while True:
             with req_lock:
                 i = next_req[0]
                 if i >= len(slices):
                     return
                 next_req[0] = i + 1
-            t0 = now()
             try:
                 batcher.submit(slices[i]).result(timeout=60)
             except RequestShed:
                 continue  # shed is an answer, not a client crash — the
                 # shed rate is reported from the serve.shed counter
-            lat[ci].append(now() - t0)
 
+    # the serving leg runs with the recorder ON: the micro-batcher feeds
+    # every request's admission->result latency into the streaming
+    # metrics core, and the percentiles below read from THAT histogram
+    prev_obs = _SCONF.get("sml.obs.enabled")
+    _SCONF.set("sml.obs.enabled", True)
+    obs.METRICS.reset()  # this pass's leg owns its distribution
     t0 = time.perf_counter()
-    with MicroBatcher(scorer.score_block,
-                      host_score=scorer.score_block_host,
-                      max_batch_rows=SERVE_MAX_BATCH_ROWS,
-                      flush_micros=SERVE_FLUSH_MICROS) as batcher:
-        threads = [threading.Thread(target=client, args=(ci, batcher))
-                   for ci in range(SERVE_CLIENTS)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-    timings["serving"] = time.perf_counter() - t0
+    try:
+        with MicroBatcher(scorer.score_block,
+                          host_score=scorer.score_block_host,
+                          max_batch_rows=SERVE_MAX_BATCH_ROWS,
+                          flush_micros=SERVE_FLUSH_MICROS) as batcher:
+            threads = [threading.Thread(target=client, args=(batcher,))
+                       for _ in range(SERVE_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        timings["serving"] = time.perf_counter() - t0
+        hist = obs.METRICS.histogram("serve.request_ms")
+        slo = obs.slo_report()
+    finally:
+        _SCONF.set("sml.obs.enabled", bool(prev_obs))
     if eng is not None:
         eng.mark("serving")
     flops["serving"] = 2.0 * len(X) * d
@@ -303,14 +320,11 @@ def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
     def delta(k):
         return c1.get(k, 0.0) - c0.get(k, 0.0)
 
-    all_lat = sorted(t for ls in lat for t in ls)
     batches = max(delta("serve.batches"), 1.0)
     reqs = max(delta("serve.requests"), 1.0)
-    metrics["serve_p50_ms"] = round(
-        1e3 * all_lat[len(all_lat) // 2], 3) if all_lat else 0.0
-    metrics["serve_p99_ms"] = round(
-        1e3 * all_lat[min(int(len(all_lat) * 0.99), len(all_lat) - 1)],
-        3) if all_lat else 0.0
+    metrics["serve_p50_ms"] = round(hist.quantile(0.50), 3) if hist else 0.0
+    metrics["serve_p99_ms"] = round(hist.quantile(0.99), 3) if hist else 0.0
+    metrics["serve_slo_burn_rate"] = slo["burn_rate"]
     # numerator = rows that actually entered a device batch (serve.rows
     # also counts shed/host-routed admissions, which would inflate this
     # exactly when the degradation ladder is active)
@@ -883,9 +897,11 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
     slice. Results merge into the bench sidecar as the `multichip`
     block, rendered by scripts/render_perf.py."""
     import jax
+    import jax.numpy as jnp
 
     from sml_tpu import obs
     from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml import tree_impl
     from sml_tpu.ml._tree_models import _fit_ensemble
     from sml_tpu.parallel import mesh as meshlib
 
@@ -902,6 +918,7 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
     GLOBAL_CONF.set("sml.obs.enabled", True)
     entries = []
     ref_pred = None
+    straggler = None
     try:
         for w in widths:
             mesh = meshlib.build_mesh(w)
@@ -923,6 +940,32 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
                     fit()
                     best = min(best, time.perf_counter() - t0)
                 pred = spec.predict_margin(probe)
+                # per-device straggler attribution (obs/_skew.py): time
+                # the same per-shard reduction on EACH chip's resident
+                # bin block (best-of-3) — the per-chip compute profile
+                # the BSP decomposition splits into compute vs
+                # collective-wait, rendered as per-device trace lanes
+                staged = tree_impl.stage_tree_data(
+                    X, y, max_bins=MULTICHIP_BINS)
+                blocks = meshlib.addressable_row_blocks(staged.binned_dev)
+                # graftlint: disable=dispatch-bypass -- skew probe: must time ONE chip's shard in isolation, untouched by routing or the mesh (a dispatched program would re-shard the block)
+                probe_fn = jax.jit(
+                    lambda b: (b.astype(jnp.float32) ** 2).sum(axis=0))
+                jax.block_until_ready(probe_fn(blocks[0][1]))  # compile
+                shard_walls = []
+                for _dev, blk in blocks:
+                    bw = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(probe_fn(blk))
+                        bw = min(bw, time.perf_counter() - t0)
+                    shard_walls.append(bw)
+                attr = obs.SKEW.note(
+                    f"multichip_{w}dev", shard_walls,
+                    devices=[d.id for d, _ in blocks], wall_s=best,
+                    psum_bytes=coll.get("collective.psum_bytes", 0.0),
+                    psum_launches=coll.get("collective.psum", 0.0))
+                straggler = obs.straggler_report()
             if ref_pred is None:
                 ref_pred = pred
             parity = bool(np.allclose(pred, ref_pred, rtol=1e-4, atol=1e-4))
@@ -936,11 +979,20 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
                 "collective_psum_bytes":
                     float(coll.get("collective.psum_bytes", 0.0)),
                 "parity_vs_1": parity,
+                "skew": None if attr is None else {
+                    "slowest_device": int(attr["slowest_device"]),
+                    "skew_ratio": round(attr["skew_ratio"], 4),
+                    "wait_share": round(attr["wait_share"], 4),
+                    "per_device_compute_ms": [round(c * 1e3, 4)
+                                              for c in shard_walls],
+                },
             })
             print(f"  multichip {w}d: {best:.3f}s "
                   f"({rows / best:,.0f} rows/s, "
                   f"psum {coll.get('collective.psum_bytes', 0) / 1e6:.2f} "
-                  f"MB/trace, parity={parity})", file=sys.stderr)
+                  f"MB/trace, parity={parity}, skew "
+                  f"{entries[-1]['skew']['skew_ratio'] if entries[-1]['skew'] else '-'}"
+                  f")", file=sys.stderr)
     finally:
         GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
     return {
@@ -950,8 +1002,13 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
         "note": "best-of-3 warm fits per mesh width; collective counters "
                 "are per-TRACE statics (multiply by executions for wire "
                 "traffic); parity_vs_1 = same forest as the 1-device "
-                "mesh (layout-invariant sampling)",
+                "mesh (layout-invariant sampling); skew = per-device "
+                "straggler attribution from per-shard compute probes "
+                "(obs/_skew.py, docs/OBSERVABILITY.md)",
         "widths": entries,
+        # aggregate straggler attribution for the WIDEST mesh (obs.reset
+        # runs per width, so the tracker holds the last width's notes)
+        "straggler": straggler,
     }
 
 
@@ -967,6 +1024,7 @@ def multichip_main(rows: int) -> None:
     with open(LEGS_FILE, "w") as f:
         json.dump(doc, f, indent=1)
     best = max(e["speedup_vs_1"] for e in block["widths"])
+    straggler = block.get("straggler") or {}
     print(json.dumps({
         "metric": "multichip fit-throughput scaling",
         "value": best,
@@ -974,6 +1032,8 @@ def multichip_main(rows: int) -> None:
         "n_devices": block["n_devices"],
         "backend": block["backend"],
         "parity_ok": all(e["parity_vs_1"] for e in block["widths"]),
+        "straggler_device": straggler.get("slowest_device"),
+        "skew_ratio": straggler.get("skew_ratio"),
         "legs_file": "bench_legs.json",
     }))
 

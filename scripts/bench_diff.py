@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""bench_diff — the perf-regression gate between two bench records.
+
+Compares a candidate bench artifact (the `bench_legs.json` sidecar a
+fresh `python bench.py` run writes, or a `BENCH_r0x.json` driver record)
+against a committed base, with NOISE-AWARE tolerances derived from each
+record's own best-of-N pass spread (see sml_tpu/obs/regress.py for the
+rules). Exit status IS the verdict: 0 = no regressions, 1 = regressed —
+so a PR's bench run gates mechanically instead of by PERF.md eyeball.
+
+Usage:
+    python scripts/bench_diff.py BASE [CAND] [--json] [--min-tol PCT]
+                                 [--trace OUT.json]
+
+With one argument the record is compared against ITSELF (the null check
+CI runs on the committed artifacts: any finding on a self-compare is a
+sentry bug). `--trace` writes the verdicts as Chrome-trace instant
+markers; in-process, `obs.annotate_regressions()` lands the same
+verdicts in the flight recorder.
+
+Loaded STANDALONE (the graftlint pattern): this script imports
+sml_tpu/obs/regress.py by file path, so the gate never imports jax and
+runs in milliseconds.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _load_regress():
+    path = os.path.join(REPO, "sml_tpu", "obs", "regress.py")
+    spec = importlib.util.spec_from_file_location("_bench_regress", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="noise-aware bench-record comparison (exit 1 on "
+                    "regression)")
+    parser.add_argument("base", help="committed bench record (sidecar or "
+                                     "BENCH_r0x driver record)")
+    parser.add_argument("cand", nargs="?", default=None,
+                        help="candidate record (default: the base itself "
+                             "— the null self-compare)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full result as JSON instead of the "
+                             "table")
+    parser.add_argument("--min-tol", type=float, default=None,
+                        help="wall-clock tolerance floor as a fraction "
+                             "(default 0.05); recorded pass spread widens "
+                             "it, capped so >=20%% always flags")
+    parser.add_argument("--trace", default=None,
+                        help="write verdicts as Chrome-trace instant "
+                             "markers to this path")
+    args = parser.parse_args(argv)
+
+    regress = _load_regress()
+    cand = args.cand or args.base
+    min_tol = args.min_tol if args.min_tol is not None else regress.MIN_TOL
+    result = regress.diff_paths(args.base, cand, min_tol)
+
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump({"traceEvents": regress.trace_events(result),
+                       "otherData": {"producer": "scripts/bench_diff.py",
+                                     "base": args.base, "cand": cand}}, f)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(regress.render(result, args.base, cand))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,16 @@ _register("sml.obs.sinkPath", "", str,
           "Optional JSONL sink: every recorded event is also appended to "
           "this file as one JSON object per line (empty = ring only). "
           "Applied immediately when set")
+_register("sml.obs.sinkMaxBytes", 64 << 20, int,
+          "Byte bound for the JSONL sink file: past it the live file "
+          "rotates ONCE to <sinkPath>.1 (replacing the previous roll) and "
+          "reopens fresh, so the sink holds at most ~2x this bound on "
+          "disk. 0 = unlimited (the pre-PR-7 behavior)")
+_register("sml.obs.metricsWindowSec", 300, int,
+          "Rolling-window span of the streaming metrics registry "
+          "(obs/_metrics.py): windowed quantiles and rates cover the "
+          "trailing this-many seconds (8 ring slots); all-time "
+          "histograms are kept regardless")
 _register("sml.obs.autoLogRunMetrics", True, _to_bool,
           "With the recorder enabled, every outermost Estimator.fit under "
           "an active tracking run logs engine.* metrics (h2d/d2h bytes, "

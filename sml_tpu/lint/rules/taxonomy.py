@@ -37,6 +37,10 @@ TARGETS = {
                  "gauge": (0, "gauge")},
     "_OBS": {"emit": (1, "emit"), "counter": (0, "counter"),
              "gauge": (0, "gauge")},
+    # streaming-metrics histograms (obs/_metrics.py): observed names are
+    # part of the same taxonomy (METRICS_NAMES, kind "observe")
+    "METRICS": {"observe": (0, "observe")},
+    "_METRICS": {"observe": (0, "observe")},
 }
 
 _HERE = os.path.dirname(os.path.abspath(__file__))

@@ -21,6 +21,16 @@ built on ONE structured event bus:
 - `engine_metrics()` + fit autologging: outermost `Estimator.fit` under an
   active tracking run logs `engine.*` metrics (the MLflow system-metrics
   mirror), gated by `sml.obs.autoLogRunMetrics`.
+- `METRICS` (`_metrics`): streaming log-bucketed histograms — latency
+  quantiles and rates without retained samples; `engine_health()` is the
+  one-call snapshot (metrics + audit + HBM ledger + SLO burn-rate),
+  surfaced live on `ServingEndpoint.health_report()`.
+- `SKEW` / `straggler_report()` (`_skew`): per-device compute vs
+  collective-wait attribution of fused mesh programs, rendered as
+  per-chip lanes in the Chrome trace.
+- `regress` (stdlib-only, also loadable standalone by
+  `scripts/bench_diff.py`): noise-aware comparison of two bench sidecars
+  — the machine-checkable perf-regression gate.
 
 See docs/OBSERVABILITY.md for the event model and worked examples.
 """
@@ -35,13 +45,17 @@ from ..conf import GLOBAL_CONF
 from . import _audit, _ledger
 from ._audit import records as audit_records, report as audit_report
 from ._ledger import LEDGER, report as memory_report
+from ._metrics import METRICS, LogHistogram, merge_snapshots
 from ._recorder import RECORDER, Event
+from ._skew import SKEW, report_from_trace as skew_report_from_trace
 from ._trace import export_chrome_trace
 
-__all__ = ["RECORDER", "Event", "LEDGER", "export_chrome_trace",
+__all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW",
+           "LogHistogram", "merge_snapshots", "export_chrome_trace",
            "audit_report", "audit_records", "memory_report",
-           "engine_metrics", "reset", "enabled", "note_compile",
-           "autolog_fit"]
+           "engine_metrics", "engine_health", "straggler_report",
+           "skew_report_from_trace", "annotate_regressions", "reset",
+           "enabled", "note_compile", "autolog_fit"]
 
 
 def enabled() -> bool:
@@ -49,10 +63,13 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop recorded events, audit records, and re-arm HBM peaks (live
-    ledger bytes persist — they describe real cache residency)."""
+    """Drop recorded events, audit records, metric histograms, skew
+    attributions, and re-arm HBM peaks (live ledger bytes persist — they
+    describe real cache residency)."""
     RECORDER.reset()
     _audit.reset()
+    METRICS.reset()
+    SKEW.reset()
     LEDGER.reset_peaks()
 
 
@@ -92,6 +109,84 @@ def engine_metrics() -> Dict[str, float]:
         "engine.hbm_peak_bytes": float(LEDGER.peak_total()),
         "engine.shuffle_rows": t.get("shuffle.rows", 0.0),
     }
+
+
+# ------------------------------------------------------------- engine health
+def straggler_report() -> Optional[Dict[str, object]]:
+    """Aggregate per-device skew attribution across every program noted
+    with `SKEW.note` (None when nothing was noted — e.g. no multichip
+    fits ran). See obs/_skew.py for the BSP decomposition."""
+    return SKEW.straggler_report()
+
+
+def slo_report(window_s: Optional[float] = None) -> Dict[str, float]:
+    """Latency-SLO burn for the serving path: the fraction of
+    `serve.request_ms` observations above `sml.serve.sloMillis`, divided
+    by the error budget (`sml.serve.sloBudget`) — burn_rate 1.0 means the
+    budget is being spent exactly as fast as allowed; >1 means an alert.
+    Breach counting is bucket-exact (within one ~9% histogram bucket of
+    the threshold)."""
+    target_ms = float(GLOBAL_CONF.get("sml.serve.sloMillis", 250))
+    budget = float(GLOBAL_CONF.get("sml.serve.sloBudget", 0.01))
+    hist = METRICS.histogram("serve.request_ms")
+    if hist is None:
+        total = breaches = 0
+    else:
+        total = hist.total_count(window_s)
+        breaches = hist.count_above(target_ms, window_s)
+    fraction = (breaches / total) if total else 0.0
+    burn = fraction / budget if budget > 0 else 0.0
+    if RECORDER.enabled and total:
+        RECORDER.gauge("slo.burn_rate", burn)
+    return {"target_ms": target_ms, "budget_fraction": budget,
+            "requests": float(total), "breaches": float(breaches),
+            "breach_fraction": round(fraction, 6),
+            "burn_rate": round(burn, 4)}
+
+
+def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
+    """ONE call, the engine's whole health surface: streaming-metric
+    quantiles (serving latency, per-route dispatch walls), the dispatch
+    audit's verdicts, the HBM ledger, the flat `engine.*` metrics, the
+    serving SLO burn-rate, and (when multichip attribution ran) the
+    straggler report. `window_s` restricts metric quantiles/rates to the
+    trailing window (None = all-time). Cheap enough to poll — everything
+    is read from bounded in-memory state."""
+    recs = audit_records()
+    measured = [r for r in recs if r.measured is not None]
+    health = {
+        "metrics": METRICS.snapshot(window_s),
+        "audit": {
+            "decisions": len(recs),
+            "measured": len(measured),
+            "misroutes": sum(1 for r in measured if r.misroute),
+            "report": audit_report(),
+        },
+        "hbm": LEDGER.snapshot(),
+        "engine": engine_metrics(),
+        "slo": slo_report(window_s),
+        "skew": straggler_report(),
+    }
+    if RECORDER.enabled:
+        RECORDER.emit("health", "health.snapshot", args={
+            "metrics": len(health["metrics"]),
+            "audit_decisions": health["audit"]["decisions"],
+            "slo_burn_rate": health["slo"]["burn_rate"]})
+    return health
+
+
+def annotate_regressions(findings) -> int:
+    """Land `obs.regress` / `scripts/bench_diff.py` verdicts in the
+    flight recorder as `regress.verdict` events, so an exported Chrome
+    trace pins each regression on the timeline next to the engine
+    activity it indicts. Returns the number of events emitted."""
+    if not RECORDER.enabled:
+        return 0
+    n = 0
+    for f in findings:
+        RECORDER.emit("regress", "regress.verdict", args=dict(f))
+        n += 1
+    return n
 
 
 _fit_depth = threading.local()

@@ -21,6 +21,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ._metrics import METRICS
 from ._recorder import RECORDER
 
 _MAX_RECORDS = 4096   # bounded like the event ring: audits must not leak
@@ -115,6 +116,9 @@ def attach(route: str, span_name: str, wall_s: float) -> None:
     most recent unmeasured decision for that route (decisions and their
     program spans share a thread by construction — dispatch resolves
     before the program span opens)."""
+    # measured walls of routed programs also stream into the metrics
+    # core's per-route latency histograms (quantiles without raw samples)
+    METRICS.observe(f"dispatch.{route}_ms", float(wall_s) * 1e3)
     q = getattr(_tls, "q", None)
     if not q:
         return

@@ -15,6 +15,7 @@ no lock, no allocation, no conf lookup.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -54,6 +55,9 @@ class Recorder:
         self._epoch = time.perf_counter()
         self._sink = None
         self._sink_path: Optional[str] = None
+        self._sink_bytes = 0
+        self._sink_max = max(int(GLOBAL_CONF.getInt("sml.obs.sinkMaxBytes")),
+                             0)
         self.dropped = 0
         # plain attribute, NOT a property: the disabled-path cost per event
         self.enabled: bool = GLOBAL_CONF.getBool("sml.obs.enabled")
@@ -74,6 +78,8 @@ class Recorder:
                         pass
                 self._sink = None
                 self._sink_path = path or None
+            self._sink_max = max(
+                int(GLOBAL_CONF.getInt("sml.obs.sinkMaxBytes")), 0)
         self.enabled = GLOBAL_CONF.getBool("sml.obs.enabled")
 
     # --------------------------------------------------------------- emit
@@ -133,6 +139,7 @@ class Recorder:
         if self._sink is None and self._sink_path:
             try:
                 self._sink = open(self._sink_path, "a")
+                self._sink_bytes = os.path.getsize(self._sink_path)
             except OSError:
                 self._sink_path = None
         return self._sink
@@ -145,8 +152,21 @@ class Recorder:
                 rec["dur"] = round(ev.dur, 6)
             if ev.args:
                 rec["args"] = ev.args
-            sink.write(json.dumps(rec, default=str) + "\n")
+            line = json.dumps(rec, default=str) + "\n"
+            sink.write(line)
             sink.flush()
+            self._sink_bytes += len(line)
+            # single rotation (sml.obs.sinkMaxBytes): the live file rolls
+            # to <path>.1 (replacing the previous roll) and reopens fresh,
+            # so the sink holds at most ~2x the bound instead of growing
+            # without limit. Runs under the emit lock, after a COMPLETE
+            # line: rotation can never split a record.
+            if self._sink_max and self._sink_bytes >= self._sink_max:
+                sink.close()
+                self._sink = None
+                os.replace(self._sink_path, self._sink_path + ".1")
+                self._sink = open(self._sink_path, "a")
+                self._sink_bytes = 0
         except (OSError, ValueError):
             self._sink_path = None  # a dead sink must not take fits down
             self._sink = None
@@ -172,5 +192,6 @@ class Recorder:
 
 RECORDER = Recorder()
 
-for _key in ("sml.obs.enabled", "sml.obs.ringEvents", "sml.obs.sinkPath"):
+for _key in ("sml.obs.enabled", "sml.obs.ringEvents", "sml.obs.sinkPath",
+             "sml.obs.sinkMaxBytes"):
     GLOBAL_CONF.on_set(_key, RECORDER.reconfigure)

@@ -28,6 +28,7 @@ from ._recorder import RECORDER, Event
 
 PID_HOST = 1
 PID_DEVICE = 2
+PID_SKEW = 3  # per-device straggler attribution: one lane per chip
 
 
 def _is_counter_track(name: str) -> bool:
@@ -46,31 +47,43 @@ def to_trace_events(events: List[Event]) -> List[dict]:
          "args": {"name": "sml_tpu host"}},
         {"ph": "M", "pid": PID_DEVICE, "tid": 0, "name": "process_name",
          "args": {"name": "device (dispatched programs)"}},
+        {"ph": "M", "pid": PID_SKEW, "tid": 0, "name": "process_name",
+         "args": {"name": "per-device (skew attribution)"}},
     ]
     seen_tids = set()
     for ev in events:
         ts_us = ev.ts * 1e6
         if ev.kind == "span":
-            pid = PID_DEVICE if _is_device_span(ev) else PID_HOST
-            key = (pid, ev.tid)
-            if key not in seen_tids:
-                seen_tids.add(key)
+            if ev.name.startswith("skew."):
+                # straggler attribution renders ONE LANE PER CHIP — the
+                # per-executor timeline, with compute and collective-wait
+                # spans stacked per device (obs/_skew.py)
+                pid, tid = PID_SKEW, int(ev.args.get("device", 0))
+                label = "device"
+            else:
+                pid, tid = (PID_DEVICE if _is_device_span(ev)
+                            else PID_HOST), ev.tid
                 label = ("dispatch-thread" if pid == PID_DEVICE
                          else "host-thread")
-                out.append({"ph": "M", "pid": pid, "tid": ev.tid,
+            key = (pid, tid)
+            if key not in seen_tids:
+                seen_tids.add(key)
+                out.append({"ph": "M", "pid": pid, "tid": tid,
                             "name": "thread_name",
-                            "args": {"name": f"{label}-{ev.tid}"}})
-            out.append({"ph": "X", "pid": pid, "tid": ev.tid,
+                            "args": {"name": f"{label}-{tid}"}})
+            out.append({"ph": "X", "pid": pid, "tid": tid,
                         "ts": ts_us, "dur": max((ev.dur or 0.0), 0.0) * 1e6,
                         "name": ev.name, "cat": ev.kind,
                         "args": dict(ev.args)})
-        elif ev.kind == "counter" and _is_counter_track(ev.name):
-            out.append({"ph": "C", "pid": PID_HOST, "tid": 0, "ts": ts_us,
-                        "name": ev.name, "cat": "counter",
-                        "args": {"value": ev.args.get("total", 0.0)}})
-        elif ev.kind in ("dispatch", "cache", "collective", "compile",
-                         "serve", "infer"):
-            # instant markers: visible pins on the timeline without lanes
+        elif ev.kind == "counter":
+            if _is_counter_track(ev.name):
+                out.append({"ph": "C", "pid": PID_HOST, "tid": 0,
+                            "ts": ts_us, "name": ev.name, "cat": "counter",
+                            "args": {"value": ev.args.get("total", 0.0)}})
+        else:
+            # every other typed event (dispatch, cache, collective,
+            # compile, serve, infer, skew, health, regress, ...) renders
+            # as an instant marker: a visible pin without a lane
             out.append({"ph": "i", "s": "t", "pid": PID_HOST,
                         "tid": ev.tid, "ts": ts_us, "name": ev.name,
                         "cat": ev.kind, "args": dict(ev.args)})

@@ -1,0 +1,291 @@
+"""Perf-regression sentry: noise-aware comparison of two bench records.
+
+Every committed bench artifact (the `bench_legs.json` sidecar, the
+`BENCH_r0x.json` / `MULTICHIP_r0x.json` driver records) carries per-leg
+wall seconds, per-pass spreads, engine-counter deltas, collective
+payload statics, and serving percentiles — but until this module nothing
+COMPARED two of them, so a perf regression shipped whenever a reviewer
+didn't eyeball PERF.md closely enough. `compare()` is the machine check:
+
+- **per-leg wall**: a leg regresses when its best-of-N seconds grow by
+  more than a NOISE-AWARE tolerance — the recorded pass-to-pass spread
+  of both runs (a leg that wobbles 12% between passes cannot be judged
+  at 5%). The noise-derived widening is CAPPED at `TOL_CAP` so with the
+  default floor a >=20% regression always flags no matter how noisy the
+  record claims to be; an explicit `min_tol` floor is always honored;
+- **engine counters**: dispatch/compile counts must not grow (the
+  grid-fusion and prewarm contracts), byte volumes not balloon;
+- **collective volume**: the multichip block's per-trace psum
+  launches/bytes are STATICS of the compiled program — any growth is a
+  real change, tolerated only 1%;
+- **serving percentiles**: load numbers on a shared host, judged at a
+  generous 50%;
+- **coverage**: a leg present in the base but missing from the
+  candidate is itself a regression (silent coverage loss).
+
+STDLIB-ONLY by design: `scripts/bench_diff.py` loads this file by path
+(the graftlint pattern), so the CI gate runs in milliseconds without
+importing jax. `obs.annotate_regressions(findings)` lands verdicts in
+the flight recorder for trace rendering.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional
+
+#: floor for the wall-clock tolerance: best-of-N runs on a shared host
+#: are never comparable tighter than this
+MIN_TOL = 0.05
+#: cap: recorded noise may WIDEN the tolerance only this far, so a >=20%
+#: wall regression is flagged regardless of how noisy either run was
+TOL_CAP = 0.18
+#: serving p50/p99 are load numbers (contention-dependent); judge loosely
+SERVE_TOL = 0.50
+#: per-trace collective statics are deterministic; 1% covers rounding
+STATIC_TOL = 0.01
+#: byte-volume counters (H2D, psum payload) below this are noise
+BYTES_FLOOR = 1 << 20
+
+#: per-leg engine counters the sentry judges, with their growth bound:
+#: ("count", slack) = cand may exceed base by max(1, slack*base);
+#: ("bytes", rel) = cand may exceed base by rel (volumes >= BYTES_FLOOR)
+COUNTER_CHECKS = {
+    "compile.programs": ("count", 0.0),
+    "tree.fit_dispatch": ("count", 0.0),
+    "staging.h2d_bytes": ("bytes", 0.25),
+    "staging.d2h_bytes": ("bytes", 0.25),
+    "collective.psum_bytes": ("bytes", STATIC_TOL),
+}
+
+_TAIL_LEG = re.compile(r"^\s+([A-Za-z_]\w*)\s+([0-9.]+)s\s*$")
+
+
+# ------------------------------------------------------------- normalization
+def normalize(doc: dict) -> dict:
+    """Any committed bench artifact -> one comparable shape:
+    {value, pass_walls, legs: {name: {seconds, passes, counters}},
+    metrics, multichip}. Understands the bench_legs.json sidecar and the
+    BENCH_r0x driver record (headline + tail text)."""
+    if "legs" in doc and isinstance(doc["legs"], dict):
+        legs = {}
+        for name, leg in doc["legs"].items():
+            legs[name] = {
+                "seconds": float(leg["seconds"]),
+                "passes": [float(x) for x in
+                           (leg.get("seconds_per_pass") or [])],
+                "counters": dict(leg.get("engine_counters") or {}),
+            }
+            for k in ("programs_compiled", "tree_fit_dispatches"):
+                if k in leg:
+                    legs[name]["counters"].setdefault(
+                        {"programs_compiled": "compile.programs",
+                         "tree_fit_dispatches": "tree.fit_dispatch"}[k],
+                        float(leg[k]))
+        return {
+            "value": float(doc.get("value", 0.0)) or None,
+            "pass_walls": [float(x) for x in
+                           (doc.get("timed_pass_walls") or [])],
+            "legs": legs,
+            "metrics": {k: float(v) for k, v in
+                        (doc.get("metrics") or {}).items()},
+            "multichip": doc.get("multichip"),
+        }
+    # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
+    parsed = doc.get("parsed") or {}
+    legs: Dict[str, dict] = {}
+    metrics: Dict[str, float] = {}
+    for line in str(doc.get("tail", "")).splitlines():
+        m = _TAIL_LEG.match(line)
+        if m:
+            legs[m.group(1)] = {"seconds": float(m.group(2)),
+                                "passes": [], "counters": {}}
+            continue
+        mm = re.match(r"^\s+([A-Za-z_]\w*)\s+([0-9.]+)\s*$", line)
+        if mm:
+            metrics[mm.group(1)] = float(mm.group(2))
+    value = parsed.get("value")
+    mc = doc.get("scaling") or doc.get("multichip")
+    return {
+        "value": float(value) if value is not None else None,
+        "pass_walls": [float(x) for x in (parsed.get("pass_walls") or [])],
+        "legs": legs,
+        "metrics": metrics,
+        "multichip": mc,
+    }
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return normalize(json.load(f))
+
+
+# ----------------------------------------------------------------- comparison
+def _spread(passes: List[float]) -> float:
+    """Pass-to-pass relative spread (max/min - 1); 0 when unrecorded."""
+    if not passes or min(passes) <= 0:
+        return 0.0
+    return max(passes) / min(passes) - 1.0
+
+
+def _wall_tol(base_passes: List[float], cand_passes: List[float],
+              min_tol: float) -> float:
+    """`min_tol` is a HARD floor (an explicit --min-tol is always
+    honored); only the noise-derived widening from recorded pass spreads
+    is capped at TOL_CAP, so with the default floor a >=20% regression
+    always flags."""
+    noise = min(max(_spread(base_passes), _spread(cand_passes)), TOL_CAP)
+    return max(min_tol, noise)
+
+
+def _finding(kind: str, key: str, base: float, cand: float, tol: float,
+             severity: str, note: str = "") -> dict:
+    ratio = (cand / base) if base else float("inf")
+    return {"kind": kind, "key": key, "base": round(base, 4),
+            "cand": round(cand, 4), "ratio": round(ratio, 4),
+            "tol": round(tol, 4), "severity": severity, "note": note}
+
+
+def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
+    """Judge `cand` (normalized) against `base`. Returns
+    {ok, regressions, improvements, checked}; `ok` is False iff any
+    regression was found."""
+    reg: List[dict] = []
+    imp: List[dict] = []
+    checked = 0
+
+    # ---- per-leg wall clock
+    for name, b in sorted(base["legs"].items()):
+        c = cand["legs"].get(name)
+        if c is None:
+            reg.append(_finding("missing-leg", name, b["seconds"], 0.0,
+                                0.0, "regression",
+                                "leg present in base, absent in candidate"))
+            continue
+        checked += 1
+        tol = _wall_tol(b["passes"], c["passes"], min_tol)
+        rel = (c["seconds"] / b["seconds"] - 1.0) if b["seconds"] else 0.0
+        if rel > tol:
+            reg.append(_finding("leg-wall", name, b["seconds"],
+                                c["seconds"], tol, "regression",
+                                f"+{100 * rel:.1f}% vs tol "
+                                f"{100 * tol:.0f}% (noise-aware)"))
+        elif rel < -tol:
+            imp.append(_finding("leg-wall", name, b["seconds"],
+                                c["seconds"], tol, "improvement"))
+        # ---- engine-counter deltas for the leg
+        for key, (mode, slack) in COUNTER_CHECKS.items():
+            bv = b["counters"].get(key)
+            cv = c["counters"].get(key)
+            if bv is None or cv is None:
+                continue
+            checked += 1
+            if mode == "count":
+                bound = bv + max(1.0, slack * bv)
+                if cv > bound:
+                    reg.append(_finding(
+                        "leg-counter", f"{name}:{key}", bv, cv, slack,
+                        "regression",
+                        "dispatch/compile count grew — the fusion/"
+                        "prewarm contract"))
+                elif cv < bv:
+                    imp.append(_finding("leg-counter", f"{name}:{key}",
+                                        bv, cv, slack, "improvement"))
+            else:
+                if max(bv, cv) >= BYTES_FLOOR and bv > 0 \
+                        and cv > bv * (1.0 + slack):
+                    reg.append(_finding(
+                        "leg-counter", f"{name}:{key}", bv, cv, slack,
+                        "regression", "byte volume grew"))
+
+    # ---- suite total
+    if base.get("value") and cand.get("value"):
+        checked += 1
+        tol = _wall_tol(base["pass_walls"], cand["pass_walls"], min_tol)
+        rel = cand["value"] / base["value"] - 1.0
+        if rel > tol:
+            reg.append(_finding("suite-wall", "value", base["value"],
+                                cand["value"], tol, "regression"))
+        elif rel < -tol:
+            imp.append(_finding("suite-wall", "value", base["value"],
+                                cand["value"], tol, "improvement"))
+
+    # ---- serving percentiles (load numbers: generous tolerance)
+    for key in ("serve_p50_ms", "serve_p99_ms"):
+        bv, cv = base["metrics"].get(key), cand["metrics"].get(key)
+        if bv and cv:
+            checked += 1
+            rel = cv / bv - 1.0
+            if rel > SERVE_TOL:
+                reg.append(_finding("serve-latency", key, bv, cv,
+                                    SERVE_TOL, "regression"))
+            elif rel < -SERVE_TOL:
+                imp.append(_finding("serve-latency", key, bv, cv,
+                                    SERVE_TOL, "improvement"))
+
+    # ---- multichip scaling block (per-trace collective statics + walls)
+    bmc, cmc = base.get("multichip"), cand.get("multichip")
+    if bmc and cmc:
+        cw = {int(e["devices"]): e for e in cmc.get("widths", [])}
+        for e in bmc.get("widths", []):
+            ce = cw.get(int(e["devices"]))
+            if ce is None:
+                continue
+            w = int(e["devices"])
+            checked += 1
+            tol = max(TOL_CAP, min_tol)  # best-of-3, no recorded passes
+            rel = ce["seconds"] / e["seconds"] - 1.0 if e["seconds"] else 0.0
+            if rel > tol:
+                reg.append(_finding("multichip-wall", f"{w}dev",
+                                    e["seconds"], ce["seconds"], tol,
+                                    "regression"))
+            for key, slack in (("collective_psum", STATIC_TOL),
+                               ("collective_psum_bytes", STATIC_TOL)):
+                bv, cv = float(e.get(key, 0)), float(ce.get(key, 0))
+                if bv > 0:
+                    checked += 1
+                    if cv > bv * (1.0 + slack):
+                        reg.append(_finding(
+                            "multichip-collective", f"{w}dev:{key}", bv,
+                            cv, slack, "regression",
+                            "per-trace collective static grew"))
+
+    return {"ok": not reg, "regressions": reg, "improvements": imp,
+            "checked": checked}
+
+
+# ------------------------------------------------------------------ rendering
+def render(result: dict, base_path: str, cand_path: str) -> str:
+    lines = [f"bench_diff: {base_path} -> {cand_path} "
+             f"({result['checked']} checks, "
+             f"{len(result['regressions'])} regressions, "
+             f"{len(result['improvements'])} improvements)"]
+    fmt = "{:<22}{:<28}{:>12}{:>12}{:>8}{:>8}  {}"
+    if result["regressions"] or result["improvements"]:
+        lines.append(fmt.format("kind", "key", "base", "cand", "ratio",
+                                "tol", "note"))
+    for f in result["regressions"] + result["improvements"]:
+        tag = "REGRESSION " if f["severity"] == "regression" else "improved "
+        lines.append(fmt.format(f["kind"], f["key"], f["base"], f["cand"],
+                                f["ratio"], f["tol"],
+                                tag + f.get("note", "")))
+    lines.append("verdict: " + ("OK" if result["ok"] else "REGRESSED"))
+    return "\n".join(lines)
+
+
+def trace_events(result: dict) -> List[dict]:
+    """Chrome-trace instant markers for every verdict — mergeable into
+    any exported engine trace (`obs.annotate_regressions` is the
+    in-process equivalent through the flight recorder)."""
+    out = []
+    for i, f in enumerate(result["regressions"] + result["improvements"]):
+        out.append({"ph": "i", "s": "g", "pid": 99, "tid": 0,
+                    "ts": float(i), "name": "regress.verdict",
+                    "cat": "regress", "args": dict(f)})
+    return out
+
+
+def diff_paths(base_path: str, cand_path: str,
+               min_tol: float = MIN_TOL) -> dict:
+    return compare(load(base_path), load(cand_path), min_tol)

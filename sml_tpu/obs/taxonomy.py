@@ -29,6 +29,9 @@ SPANS = {
     "program.*",          # program.<fn> / program.tree_ensemble / ...
     # serving layer: one coalesced device dispatch of the micro-batcher
     "serve.batch",
+    # per-device straggler attribution (obs/_skew.py): skew.compute /
+    # skew.wait lanes rendered on the trace exporter's per-device process
+    "skew.*",
 }
 
 COUNTERS = {
@@ -68,6 +71,9 @@ COUNTERS = {
 GAUGES = {
     "hbm.*",              # hbm.<pool>_bytes / hbm.total_bytes
     "serve.queue_rows",   # rows admitted but not yet dispatched
+    "slo.*",              # slo.burn_rate: breach fraction vs the
+                          # sml.serve.sloMillis error budget, stamped by
+                          # obs.engine_health()
 }
 
 EVENTS = {
@@ -78,10 +84,25 @@ EVENTS = {
     "serve.*",            # serve.swap (endpoint hot-swap receipts)
     "infer.*",            # infer.dispatch / infer.drain (batch pipelining)
     "prewarm.*",          # prewarm.start / prewarm.replay / prewarm.done
+    "skew.*",             # skew.note (per-program attribution summary)
+                          # plus the skew.compute/skew.wait per-device
+                          # lanes emitted as kind="span" through the raw
+                          # RECORDER.emit path
+    "health.*",           # health.snapshot (engine_health() receipts)
+    "regress.*",          # regress.verdict (bench_diff annotations)
+}
+
+# streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency
+# and size distributions kept as log-bucketed counts, NOT recorder events
+METRICS_NAMES = {
+    "serve.request_ms",   # micro-batcher admission -> result per request
+    "dispatch.*",         # dispatch.host_ms / dispatch.device_ms: measured
+                          # walls of routed programs (fed by the audit's
+                          # attach path)
 }
 
 _BY_KIND = {"span": SPANS, "count": COUNTERS, "counter": COUNTERS,
-            "gauge": GAUGES, "emit": EVENTS}
+            "gauge": GAUGES, "emit": EVENTS, "observe": METRICS_NAMES}
 
 
 def _match(name: str, registry: Iterable[str]) -> bool:

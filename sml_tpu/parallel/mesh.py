@@ -242,6 +242,17 @@ def mesh_device_count(mesh: Optional[Mesh] = None) -> int:
     return math.prod(mesh.devices.shape)
 
 
+def addressable_row_blocks(arr) -> list:
+    """One (device, shard_block) pair per addressable shard of a
+    row-sharded array, ordered by row position — the per-chip view a
+    straggler probe iterates (each block is a jax.Array RESIDENT on its
+    device, so timing an op over it measures that chip alone). See
+    obs/_skew.py for the attribution these timings feed."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: tuple(sl.start or 0 for sl in s.index))
+    return [(s.device, s.data) for s in shards]
+
+
 PLACEMENT_LOG: list = []  # (trial_index, device_id tuple) per placed trial
 _PLACEMENT_LOG_MAX = 4096
 

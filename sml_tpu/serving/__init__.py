@@ -65,6 +65,15 @@ _register("sml.serve.hostFallback", True, _to_bool,
 _register("sml.serve.modelCacheBytes", 1 << 30, int,
           "Byte budget for the serving multi-model LRU cache of warm "
           "DeviceScorers (costed by DeviceScorer.resident_bytes)")
+_register("sml.serve.sloMillis", 250, int,
+          "Per-request latency SLO target (milliseconds, admission to "
+          "result): the streaming serve.request_ms histogram counts "
+          "breaches against it, and obs.engine_health() reports the "
+          "burn rate of the error budget")
+_register("sml.serve.sloBudget", 0.01, float,
+          "Latency-SLO error budget: the fraction of requests ALLOWED "
+          "over sml.serve.sloMillis. burn_rate = breach_fraction / "
+          "budget, so 1.0 = spending the budget exactly, >1 = alerting")
 _register("sml.serve.canaryFraction", 0.0, float,
           "Fraction of endpoint traffic mirrored to the Staging version "
           "(shadow/canary mode): mirrored requests score on the host "

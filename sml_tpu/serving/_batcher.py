@@ -36,6 +36,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..conf import GLOBAL_CONF
+from ..obs._metrics import METRICS as _METRICS
 from ..obs._recorder import RECORDER as _OBS
 from ..parallel import dispatch
 from ..utils.profiler import PROFILER, now
@@ -193,6 +194,8 @@ class MicroBatcher:
             try:
                 pending.future._set(np.asarray(
                     self._host_score(pending.X), dtype=np.float64))
+                _METRICS.observe("serve.request_ms",
+                                 (now() - pending.t_enqueue) * 1e3)
             except BaseException as e:  # noqa: BLE001 — future carries it
                 pending.future._set_error(e)
             return pending.future
@@ -292,9 +295,16 @@ class MicroBatcher:
             if pad > 0:
                 PROFILER.count("serve.batch_pad_rows", float(pad))
             lo = 0
+            done = now()
             for p in live:
                 p.future._set(out[lo:lo + p.n])
                 lo += p.n
+                # per-request latency (admission -> result) into the
+                # streaming metrics core: serve percentiles and the SLO
+                # burn-rate come from this histogram, never from raw
+                # sample lists (bench.py's sort path is gone)
+                _METRICS.observe("serve.request_ms",
+                                 (done - p.t_enqueue) * 1e3)
         except BaseException as e:  # noqa: BLE001 — futures carry it
             for p in live:
                 p.future._set_error(e)

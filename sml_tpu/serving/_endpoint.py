@@ -219,6 +219,29 @@ class ServingEndpoint:
                                 if out["rows"] else 0.0)
         return out
 
+    # ---------------------------------------------------------------- health
+    def health_report(self, window_s: Optional[float] = None
+                      ) -> Dict[str, object]:
+        """The live health surface for THIS endpoint: the engine-wide
+        `obs.engine_health()` snapshot (streaming-metric quantiles incl.
+        `serve.request_ms`, dispatch audit, HBM ledger, SLO burn-rate)
+        plus the endpoint's own state — resolved version, queue depth,
+        and canary divergence. Everything reads bounded in-memory state,
+        so a liveness probe can poll it."""
+        from .. import obs
+        health = obs.engine_health(window_s)
+        health["endpoint"] = {
+            "name": self._name,
+            "stage": self._stage,
+            "version": self._version,
+            "staging_version": self._staging_version,
+            "queued_rows": self._batcher.queued_rows(),
+            "max_batch_rows": self._batcher.max_batch_rows,
+            "closed": self._closed,
+            "canary": self.canary_stats(),
+        }
+        return health
+
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
         self._closed = True

@@ -394,3 +394,39 @@ def test_canary_fraction_paces_mirroring(registered_pair):
             import time
             time.sleep(0.02)
         assert ep.canary_stats()["mirrored"] == 2  # every 4th request
+
+
+# ----------------------------------------------------------------- health
+def test_health_report_exposes_engine_health_live(registered_pair):
+    """ISSUE 7 acceptance: ServingEndpoint.health_report() surfaces the
+    obs.engine_health() snapshot live — populated serve.request_ms
+    quantiles from real traffic, the SLO block, and the endpoint's own
+    resolved-version/queue/canary state."""
+    from sml_tpu import obs
+
+    m1, m2, X = registered_pair
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    try:
+        obs.METRICS.reset()
+        with ServingEndpoint("serve-model", "Production",
+                             flush_micros=200) as ep:
+            for i in range(6):
+                ep.score(X[i:i + 2], timeout=30)
+            health = ep.health_report()
+        m = health["metrics"]["serve.request_ms"]
+        assert m["count"] == 6
+        assert m["p50"] > 0 and m["p99"] >= m["p50"]
+        assert health["slo"]["requests"] == 6
+        assert health["slo"]["target_ms"] == 250.0
+        assert "burn_rate" in health["slo"]
+        assert "_total" in health["hbm"]
+        assert "decisions" in health["audit"]
+        ep_block = health["endpoint"]
+        assert ep_block["name"] == "serve-model"
+        assert ep_block["stage"] == "Production"
+        assert ep_block["version"] == 1
+        assert ep_block["queued_rows"] == 0
+        assert ep_block["canary"]["mirrored"] == 0
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", False)
+        obs.reset()
