@@ -325,6 +325,12 @@ def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
     metrics["serve_p50_ms"] = round(hist.quantile(0.50), 3) if hist else 0.0
     metrics["serve_p99_ms"] = round(hist.quantile(0.99), 3) if hist else 0.0
     metrics["serve_slo_burn_rate"] = slo["burn_rate"]
+    # the LITERAL worst request of the leg, by trace-id exemplar
+    # (obs/_context.py): the id to chase through an exported trace's
+    # flow arrows. A sidecar annotation, not a perf number — excluded
+    # from golden pins (serve_*) and ignored by the bench_diff sentry
+    # (non-numeric)
+    metrics["serve_worst_trace"] = slo.get("worst_trace") or ""
     # numerator = rows that actually entered a device batch (serve.rows
     # also counts shed/host-routed admissions, which would inflate this
     # exactly when the degradation ladder is active)
@@ -1267,7 +1273,8 @@ def main():
               f"{hb if hb is not None else float('nan'):7.2f}s  "
               f"{per_leg[k].get('speedup_vs_host')}x)", file=sys.stderr)
     for k, v in sorted(metrics.items()):
-        print(f"  {k:22s} {v:10.3f}", file=sys.stderr)
+        val = f"{v:10.3f}" if isinstance(v, (int, float)) else f"{v:>10}"
+        print(f"  {k:22s} {val}", file=sys.stderr)
 
     golden_ok, golden_drifts = (check_goldens(metrics)
                                 if backend == "tpu" else (True, {}))
@@ -1325,7 +1332,10 @@ def main():
         "backend": backend,
         "n_rows": N_ROWS,
         "n_scale_rows": N_SCALE,
-        "metrics": {k: float(v) for k, v in metrics.items()},
+        # non-numeric values (the serve_worst_trace exemplar) pass
+        # through as annotations — bench_diff only judges numbers
+        "metrics": {k: (float(v) if isinstance(v, (int, float)) else v)
+                    for k, v in metrics.items()},
         "legs": per_leg,
     }
     with open(LEGS_FILE, "w") as f:
@@ -1389,6 +1399,13 @@ if __name__ == "__main__":
                              "bench record from a tree violating engine "
                              "invariants (stray host syncs, bypassed "
                              "dispatch) measures the wrong engine")
+    parser.add_argument("--blackbox-on-fail", action="store_true",
+                        help="arm black-box forensics (sml_tpu/obs/"
+                             "blackbox.py): run with the flight recorder "
+                             "on, and dump a postmortem bundle to "
+                             "sml.obs.blackboxDir on an unhandled "
+                             "exception, a hard stall, or a failed exit "
+                             "— render it with scripts/blackbox_view.py")
     args = parser.parse_args()
     if args.prewarm:
         from sml_tpu.conf import GLOBAL_CONF as _CONF0
@@ -1397,9 +1414,27 @@ if __name__ == "__main__":
         print("bench: refusing to record — graftlint found violations "
               "(fix them or run without --lint)", file=sys.stderr)
         sys.exit(1)
-    if args.pin_goldens:
-        pin_goldens()
-    elif args.multichip:
-        multichip_main(args.multichip_rows)
+    entry = (pin_goldens if args.pin_goldens else
+             (lambda: multichip_main(args.multichip_rows))
+             if args.multichip else main)
+    if args.blackbox_on_fail:
+        from sml_tpu.conf import GLOBAL_CONF as _CONF1
+        from sml_tpu.obs import blackbox as _blackbox
+        _CONF1.set("sml.obs.enabled", True)
+        _blackbox.install()
+        try:
+            entry()
+        except SystemExit as e:
+            # the excepthook never sees SystemExit (a golden-gate
+            # failure exits 1 that way) — dump here; every OTHER
+            # exception propagates to the armed excepthook, which dumps
+            # exactly once
+            if e.code not in (None, 0):
+                path = _blackbox.dump_blackbox("bench-failure",
+                                               exc=sys.exc_info())
+                print(f"bench: blackbox bundle written: {path} "
+                      f"(render with scripts/blackbox_view.py)",
+                      file=sys.stderr)
+            raise
     else:
-        main()
+        entry()

@@ -31,6 +31,22 @@ built on ONE structured event bus:
 - `regress` (stdlib-only, also loadable standalone by
   `scripts/bench_diff.py`): noise-aware comparison of two bench sidecars
   — the machine-checkable perf-regression gate.
+- `TraceContext` / `current_trace` (`_context`): causal request tracing
+  — a context minted at serving admission rides contextvars (with
+  explicit cross-thread handoff) through micro-batch coalescing, the
+  dispatch decision, program spans, collective notes, and prewarm
+  replays; the trace exporter draws Chrome flow arrows across the hops
+  and `METRICS` histograms carry per-bucket trace-id exemplars.
+- `WATCHDOG` (`_watchdog`): in-flight stall detection — dispatch
+  launches, micro-batch flushes, collective bring-up, and prewarm
+  replays register tickets; anything exceeding `sml.obs.stallFactor` x
+  its audit-predicted wall (floor `sml.obs.stallMillis`) is flagged
+  with all-thread stack snapshots, surfaced as the `inflight` block of
+  `engine_health()`.
+- `dump_blackbox` / `install_blackbox` (`blackbox`): black-box
+  postmortem bundles (ring + metrics + audit + ledger + in-flight
+  tickets + stacks + conf) on unhandled exception, hard stall, or
+  demand — rendered offline by `scripts/blackbox_view.py` without jax.
 
 See docs/OBSERVABILITY.md for the event model and worked examples.
 """
@@ -42,15 +58,22 @@ import threading
 from typing import Dict, Optional
 
 from ..conf import GLOBAL_CONF
-from . import _audit, _ledger
+from . import _audit, _context, _ledger
 from ._audit import records as audit_records, report as audit_report
+from ._context import TraceContext, activate as activate_trace, \
+    current as current_trace, hex_id as trace_hex, new_trace
 from ._ledger import LEDGER, report as memory_report
 from ._metrics import METRICS, LogHistogram, merge_snapshots
 from ._recorder import RECORDER, Event
 from ._skew import SKEW, report_from_trace as skew_report_from_trace
 from ._trace import export_chrome_trace
+from ._watchdog import WATCHDOG, all_thread_stacks
+from .blackbox import dump_blackbox, install as install_blackbox
 
-__all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW",
+__all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW", "WATCHDOG",
+           "TraceContext", "current_trace", "new_trace", "activate_trace",
+           "trace_hex", "all_thread_stacks", "dump_blackbox",
+           "install_blackbox",
            "LogHistogram", "merge_snapshots", "export_chrome_trace",
            "audit_report", "audit_records", "memory_report",
            "engine_metrics", "engine_health", "straggler_report",
@@ -64,12 +87,14 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Drop recorded events, audit records, metric histograms, skew
-    attributions, and re-arm HBM peaks (live ledger bytes persist — they
-    describe real cache residency)."""
+    attributions, watchdog statistics, and re-arm HBM peaks (live ledger
+    bytes and OPEN watchdog tickets persist — they describe real cache
+    residency / real in-flight work)."""
     RECORDER.reset()
     _audit.reset()
     METRICS.reset()
     SKEW.reset()
+    WATCHDOG.reset()
     LEDGER.reset_peaks()
 
 
@@ -129,11 +154,18 @@ def slo_report(window_s: Optional[float] = None) -> Dict[str, float]:
     target_ms = float(GLOBAL_CONF.get("sml.serve.sloMillis", 250))
     budget = float(GLOBAL_CONF.get("sml.serve.sloBudget", 0.01))
     hist = METRICS.histogram("serve.request_ms")
+    # worst_ms/worst_trace are ALL-TIME exemplars: on a windowed report
+    # they stay None so every populated field covers the same range (the
+    # PR-7 snapshot contract) — a window-clean report must not name a
+    # worst request from outside the window
+    worst_ms, worst_trace = 0.0, None
     if hist is None:
         total = breaches = 0
     else:
         total = hist.total_count(window_s)
         breaches = hist.count_above(target_ms, window_s)
+        if window_s is None:
+            worst_ms, worst_trace = hist.worst()
     fraction = (breaches / total) if total else 0.0
     burn = fraction / budget if budget > 0 else 0.0
     if RECORDER.enabled and total:
@@ -141,7 +173,11 @@ def slo_report(window_s: Optional[float] = None) -> Dict[str, float]:
     return {"target_ms": target_ms, "budget_fraction": budget,
             "requests": float(total), "breaches": float(breaches),
             "breach_fraction": round(fraction, 6),
-            "burn_rate": round(burn, 4)}
+            "burn_rate": round(burn, 4),
+            # the LITERAL worst request, by trace-id exemplar: the id to
+            # chase through an exported trace's flow arrows
+            "worst_ms": round(float(worst_ms), 3),
+            "worst_trace": _context.hex_id(worst_trace)}
 
 
 def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
@@ -166,6 +202,10 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         "engine": engine_metrics(),
         "slo": slo_report(window_s),
         "skew": straggler_report(),
+        # in-flight watchdog tickets (obs/_watchdog.py): what is running
+        # RIGHT NOW, how long it has been, and whether it broke its own
+        # prediction — the block a liveness probe reads during a hang
+        "inflight": WATCHDOG.report(),
     }
     if RECORDER.enabled:
         RECORDER.emit("health", "health.snapshot", args={

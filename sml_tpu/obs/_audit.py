@@ -21,6 +21,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional
 
+from . import _context
 from ._metrics import METRICS
 from ._recorder import RECORDER
 
@@ -104,11 +105,27 @@ def record(hint, route: str, t_host: float, t_device: float,
     with _lock:
         _records.append(rec)
     _pending().append(rec)
-    RECORDER.emit("dispatch", f"dispatch.{route}", args={
+    # the riding trace context (obs/_context.py) tags the decision, so a
+    # request's causal chain includes WHY its work went where it went
+    RECORDER.emit("dispatch", f"dispatch.{route}", args=_context.trace_args({
         "kind": rec.kind, "flops": rec.flops, "route": route,
         "forced": forced, "reason": reason,
-        "t_host": round(t_host, 6), "t_device": round(t_device, 6)})
+        "t_host": round(t_host, 6), "t_device": round(t_device, 6)}))
     RECORDER.counter(f"dispatch.route_{route}")
+
+
+def expected_wall(route: str) -> Optional[float]:
+    """The PREDICTED wall of this thread's most recent unmeasured
+    decision for `route` — the stall watchdog's per-ticket expectation
+    (a dispatch is only "stalled" once it has broken its own
+    prediction by sml.obs.stallFactor x)."""
+    q = getattr(_tls, "q", None)
+    if not q:
+        return None
+    for rec in reversed(q):
+        if rec.route == route and rec.measured is None:
+            return rec.predicted
+    return None
 
 
 def attach(route: str, span_name: str, wall_s: float) -> None:
@@ -117,8 +134,11 @@ def attach(route: str, span_name: str, wall_s: float) -> None:
     program spans share a thread by construction — dispatch resolves
     before the program span opens)."""
     # measured walls of routed programs also stream into the metrics
-    # core's per-route latency histograms (quantiles without raw samples)
-    METRICS.observe(f"dispatch.{route}_ms", float(wall_s) * 1e3)
+    # core's per-route latency histograms (quantiles without raw
+    # samples); the riding trace id becomes the bucket's exemplar
+    ctx = _context.current()
+    METRICS.observe(f"dispatch.{route}_ms", float(wall_s) * 1e3,
+                    exemplar=None if ctx is None else ctx.trace_id)
     q = getattr(_tls, "q", None)
     if not q:
         return

@@ -62,6 +62,12 @@ class LogHistogram:
         self._slot_w = max(self._window_s / _SLOTS, 1e-3)
         self._buckets: Dict[int, int] = {}
         self._slots: List[list] = []   # [slot_start, {bucket: count}, count]
+        #: per-bucket EXEMPLARS (PR 8): the last trace id observed into
+        #: each bucket, so a histogram quantile can name a LITERAL
+        #: request to go look at in the trace — the OpenMetrics exemplar
+        #: idea, one id per bucket, O(buckets) memory like the counts
+        self._exemplars: Dict[int, int] = {}
+        self._max_exemplar: Optional[int] = None
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
@@ -69,7 +75,7 @@ class LogHistogram:
         self._t0 = time.perf_counter()
 
     # ------------------------------------------------------------ recording
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[int] = None) -> None:
         v = float(value)
         idx = _bucket_of(v)
         now = time.perf_counter()
@@ -77,8 +83,16 @@ class LogHistogram:
             self._buckets[idx] = self._buckets.get(idx, 0) + 1
             self.count += 1
             self.sum += v
+            if exemplar is not None:
+                self._exemplars[idx] = exemplar
             if v > self.max:
+                # a new max REPLACES the exemplar even when this
+                # observation carries none: worst() must never pair the
+                # new max with a stale (smaller) observation's trace
                 self.max = v
+                self._max_exemplar = exemplar
+            elif v == self.max and exemplar is not None:
+                self._max_exemplar = exemplar
             if v < self.min:
                 self.min = v
             slot = self._slots[-1] if self._slots else None
@@ -96,13 +110,21 @@ class LogHistogram:
         into a fleet view by bucket addition)."""
         with other._lock:
             buckets = dict(other._buckets)
+            exemplars = dict(other._exemplars)
             count, total = other.count, other.sum
             mx, mn = other.max, other.min
+            mx_ex = other._max_exemplar
         with self._lock:
             for idx, c in buckets.items():
                 self._buckets[idx] = self._buckets.get(idx, 0) + c
+            for idx, ex in exemplars.items():
+                self._exemplars.setdefault(idx, ex)
             self.count += count
             self.sum += total
+            if mx > self.max:
+                # the larger max brings ITS exemplar (possibly None) —
+                # never keep an exemplar from a smaller observation
+                self._max_exemplar = mx_ex
             self.max = max(self.max, mx)
             self.min = min(self.min, mn)
 
@@ -149,6 +171,13 @@ class LogHistogram:
         return sum(c for idx, c in buckets.items()
                    if _bucket_mid(idx) > threshold)
 
+    def worst(self) -> tuple:
+        """(max observed value, its exemplar trace id or None) — the
+        literal worst request the histogram saw, for engine_health() and
+        the bench sidecar to name."""
+        with self._lock:
+            return (self.max, self._max_exemplar)
+
     def rate_per_s(self, window_s: Optional[float] = None) -> float:
         """Observations per second over the rolling window (or since the
         histogram was created when `window_s` is None)."""
@@ -184,7 +213,7 @@ class LogHistogram:
                         / count) if count else 0.0
                 mx = _bucket_mid(max(merged)) if merged else 0.0
                 mn = _bucket_mid(min(merged)) if merged else 0.0
-        return {
+        out = {
             "count": count,
             "mean": mean,
             "p50": self.quantile(0.50, window_s),
@@ -195,6 +224,18 @@ class LogHistogram:
             "rate_per_s": round(self.rate_per_s(window_s), 3),
             "buckets": {str(k): v for k, v in merged.items()},
         }
+        # exemplars are all-time (per-bucket "go look at THIS trace"
+        # pointers, not windowed statistics) — attached only to the
+        # all-time snapshot so every windowed field keeps covering the
+        # same range
+        if window_s is None:
+            with self._lock:
+                if self._exemplars:
+                    out["exemplars"] = {str(k): v for k, v in
+                                        self._exemplars.items()}
+                if self._max_exemplar is not None:
+                    out["max_exemplar"] = self._max_exemplar
+        return out
 
 
 def merge_snapshots(a: Dict[str, object], b: Dict[str, object]) -> Dict[str, object]:
@@ -221,7 +262,7 @@ def merge_snapshots(a: Dict[str, object], b: Dict[str, object]) -> Dict[str, obj
     count = a["count"] + b["count"]
     total = a["mean"] * a["count"] + b["mean"] * b["count"]
     mins = [s["min"] for s in (a, b) if s["count"]]
-    return {
+    out = {
         "count": count,
         "mean": (total / count) if count else 0.0,
         "p50": q(0.50), "p90": q(0.90), "p99": q(0.99),
@@ -230,6 +271,13 @@ def merge_snapshots(a: Dict[str, object], b: Dict[str, object]) -> Dict[str, obj
         "rate_per_s": 0.0,  # rates do not merge across unknown spans
         "buckets": {str(k): v for k, v in buckets.items()},
     }
+    exemplars = {**a.get("exemplars", {}), **b.get("exemplars", {})}
+    if exemplars:
+        out["exemplars"] = exemplars
+    winner = a if a["max"] >= b["max"] else b
+    if "max_exemplar" in winner:
+        out["max_exemplar"] = winner["max_exemplar"]
+    return out
 
 
 class MetricsRegistry:
@@ -243,14 +291,24 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._hists: Dict[str, LogHistogram] = {}
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                exemplar: Optional[int] = None) -> None:
+        """`exemplar` is an optional trace id (obs/_context.py) attached
+        to the observation's bucket — quantiles stay aggregate, but the
+        worst bucket can name a literal request to go look at."""
         if not self._rec.enabled:
             return
         h = self._hists.get(name)
         if h is None:
             with self._lock:
                 h = self._hists.setdefault(name, LogHistogram())
-        h.observe(value)
+        h.observe(value, exemplar)
+
+    def worst(self, name: str) -> tuple:
+        """(max value, exemplar trace id or None) for one metric — (0.0,
+        None) when the histogram does not exist."""
+        h = self._hists.get(name)
+        return h.worst() if h is not None else (0.0, None)
 
     def histogram(self, name: str) -> Optional[LogHistogram]:
         return self._hists.get(name)

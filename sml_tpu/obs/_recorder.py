@@ -45,6 +45,30 @@ class Event:
     args: Dict[str, object] = field(default_factory=dict)
 
 
+def event_record(ev: Event) -> Dict[str, object]:
+    """ONE line shape for serialized events — the JSONL sink and the
+    blackbox bundle's events.jsonl both write exactly this, so a field
+    added to `Event` changes every consumer (and blackbox_view's reader)
+    in one place."""
+    rec: Dict[str, object] = {"ts": round(ev.ts, 6), "kind": ev.kind,
+                              "name": ev.name, "tid": ev.tid}
+    if ev.dur is not None:
+        rec["dur"] = round(ev.dur, 6)
+    if ev.args:
+        rec["args"] = ev.args
+    return rec
+
+
+#: bound on the thread-id -> dense-tid map: serving's short-lived client
+#: threads would otherwise grow it forever. Past the bound, slots of DEAD
+#: threads are reclaimed and reused (a reused lane shows a new thread's
+#: events after the old thread's death — acceptable for a trace, fatal
+#: for a leak). 512 concurrent LIVE threads still grow — correctness
+#: over the bound — but the dead-thread leak is closed (asserted in
+#: tests/test_obs.py).
+_MAX_TIDS = 512
+
+
 class Recorder:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -52,6 +76,8 @@ class Recorder:
             maxlen=max(int(GLOBAL_CONF.getInt("sml.obs.ringEvents")), 16))
         self._totals: Dict[str, float] = {}
         self._tids: Dict[int, int] = {}
+        self._free_tids: List[int] = []
+        self._next_tid = 0
         self._epoch = time.perf_counter()
         self._sink = None
         self._sink_path: Optional[str] = None
@@ -94,10 +120,10 @@ class Recorder:
         ident = threading.get_ident()
         with self._lock:
             # tid assignment under the lock: two threads' first emits must
-            # not share a lane (len() is not a unique id outside it)
+            # not share a lane (a counter read outside it is not unique)
             tid = self._tids.get(ident)
             if tid is None:
-                tid = self._tids[ident] = len(self._tids)
+                tid = self._claim_tid_locked(ident)
             ev = Event(ts=max(at, 0.0), kind=kind, name=name, dur=dur,
                        tid=tid, args=args or {})
             if len(self._ring) == self._ring.maxlen:
@@ -106,6 +132,32 @@ class Recorder:
             sink = self._ensure_sink()
             if sink is not None:  # under the lock: lines must not interleave
                 self._write_sink(ev, sink)
+
+    def _claim_tid_locked(self, ident: int) -> int:
+        """Dense lane id for a newly-seen thread. At the _MAX_TIDS bound,
+        dead threads' slots are reclaimed first (the serving layer's
+        short-lived client threads must not grow the map forever)."""
+        if len(self._tids) >= _MAX_TIDS and not self._free_tids:
+            live = {t.ident for t in threading.enumerate()}
+            for dead in [i for i in self._tids if i not in live]:
+                self._free_tids.append(self._tids.pop(dead))
+        if self._free_tids:
+            tid = self._free_tids.pop()
+        else:
+            tid = self._next_tid
+            self._next_tid += 1
+        self._tids[ident] = tid
+        return tid
+
+    def epoch_unix(self) -> float:
+        """Wall-clock (Unix epoch) instant of ts=0 on this recorder's
+        timeline — the absolute anchor postmortems need to correlate
+        events with external logs. Derived on demand from the live
+        offset between the epoch clock and the perf_counter domain
+        (both advance together), stamped into sink headers, exported
+        traces, and blackbox bundles."""
+        from ..utils.profiler import now, wallclock
+        return wallclock() - (now() - self._epoch)
 
     def counter(self, name: str, inc: float = 1.0) -> None:
         """Cumulative counter: bumps the running total and records a
@@ -135,24 +187,37 @@ class Recorder:
                   args={k: v for k, v in meta.items() if v is not None})
 
     # --------------------------------------------------------------- sink
+    def _sink_header_locked(self, sink) -> None:
+        """Anchor line stamped whenever the sink (re)opens: an
+        event-shaped record carrying the wall-clock epoch, so a
+        postmortem reader can place the relative timeline against
+        external logs. Event-shaped (kind "meta") so line-oriented
+        consumers need no special case."""
+        try:
+            hdr = {"ts": 0.0, "kind": "meta", "name": "obs.header",
+                   "args": {"version": 1,
+                            "epoch_unix": round(self.epoch_unix(), 6),
+                            "pid": os.getpid()}}
+            line = json.dumps(hdr) + "\n"
+            sink.write(line)
+            sink.flush()
+            self._sink_bytes += len(line)
+        except (OSError, ValueError):
+            pass  # a header failure must not take the sink down
+
     def _ensure_sink(self):
         if self._sink is None and self._sink_path:
             try:
                 self._sink = open(self._sink_path, "a")
                 self._sink_bytes = os.path.getsize(self._sink_path)
+                self._sink_header_locked(self._sink)
             except OSError:
                 self._sink_path = None
         return self._sink
 
     def _write_sink(self, ev: Event, sink) -> None:
         try:
-            rec = {"ts": round(ev.ts, 6), "kind": ev.kind, "name": ev.name,
-                   "tid": ev.tid}
-            if ev.dur is not None:
-                rec["dur"] = round(ev.dur, 6)
-            if ev.args:
-                rec["args"] = ev.args
-            line = json.dumps(rec, default=str) + "\n"
+            line = json.dumps(event_record(ev), default=str) + "\n"
             sink.write(line)
             sink.flush()
             self._sink_bytes += len(line)
@@ -167,6 +232,7 @@ class Recorder:
                 os.replace(self._sink_path, self._sink_path + ".1")
                 self._sink = open(self._sink_path, "a")
                 self._sink_bytes = 0
+                self._sink_header_locked(self._sink)
         except (OSError, ValueError):
             self._sink_path = None  # a dead sink must not take fits down
             self._sink = None
@@ -182,12 +248,17 @@ class Recorder:
 
     def reset(self) -> None:
         """Drop all events/totals and re-zero the epoch (enabled state and
-        sink configuration survive)."""
+        sink configuration survive). An OPEN sink gets a fresh header
+        line: its previous epoch_unix anchor no longer describes the
+        re-zeroed timeline, and a postmortem reader re-anchors at the
+        newest header above each line."""
         with self._lock:
             self._ring.clear()
             self._totals.clear()
             self.dropped = 0
             self._epoch = time.perf_counter()
+            if self._sink is not None:
+                self._sink_header_locked(self._sink)
 
 
 RECORDER = Recorder()

@@ -1,22 +1,12 @@
 """Chrome/Perfetto `trace.json` exporter over the flight-recorder ring.
 
-Track layout (the Spark-UI executor-timeline equivalent):
-
-- pid 1 "sml_tpu host": one lane per recording host thread; every span
-  event renders as a complete ("X") event, so nested engine spans stack
-  exactly as the profiler measured them.
-- pid 2 "device (dispatched programs)": the virtual device track —
-  `program.*` spans whose dispatch route was "device" are drawn here (one
-  lane per dispatching thread, so concurrent tuning trials stay legible).
-  Wall time on this track includes the host-side dispatch+readback wait:
-  that IS the cost the dispatcher prices, and the honest number for a
-  tunneled chip.
-- counter tracks ("C" events, pid 1): every byte-volume counter
-  (`*_bytes*`) and HBM ledger gauge (`hbm.*`) renders its cumulative
-  total / live value at each change — H2D/D2H traffic and cache
-  occupancy over time.
-
-Load the file at chrome://tracing or https://ui.perfetto.dev.
+The conversion itself lives in `_tracefmt` (pure, stdlib-only, dict in /
+dict out) so `scripts/blackbox_view.py` can render a postmortem bundle
+with the SAME track layout without importing this package (or jax);
+this module binds it to the live ring and the filesystem. See
+`_tracefmt`'s docstring for the track layout and the causal flow-event
+pass; load exported files at chrome://tracing or
+https://ui.perfetto.dev.
 """
 
 from __future__ import annotations
@@ -25,78 +15,30 @@ import json
 from typing import List
 
 from ._recorder import RECORDER, Event
+from ._tracefmt import PID_DEVICE, PID_HOST, PID_SKEW, to_trace_dicts, \
+    trace_doc
 
-PID_HOST = 1
-PID_DEVICE = 2
-PID_SKEW = 3  # per-device straggler attribution: one lane per chip
-
-
-def _is_counter_track(name: str) -> bool:
-    return ("_bytes" in name or name.endswith(".bytes")
-            or name.startswith("hbm."))
+__all__ = ["PID_HOST", "PID_DEVICE", "PID_SKEW", "to_trace_events",
+           "export_chrome_trace"]
 
 
-def _is_device_span(ev: Event) -> bool:
-    return ev.name.startswith("program.") \
-        and ev.args.get("route") == "device"
+def _as_records(events: List[Event]) -> List[dict]:
+    return [{"ts": ev.ts, "kind": ev.kind, "name": ev.name, "dur": ev.dur,
+             "tid": ev.tid, "args": ev.args} for ev in events]
 
 
 def to_trace_events(events: List[Event]) -> List[dict]:
-    out: List[dict] = [
-        {"ph": "M", "pid": PID_HOST, "tid": 0, "name": "process_name",
-         "args": {"name": "sml_tpu host"}},
-        {"ph": "M", "pid": PID_DEVICE, "tid": 0, "name": "process_name",
-         "args": {"name": "device (dispatched programs)"}},
-        {"ph": "M", "pid": PID_SKEW, "tid": 0, "name": "process_name",
-         "args": {"name": "per-device (skew attribution)"}},
-    ]
-    seen_tids = set()
-    for ev in events:
-        ts_us = ev.ts * 1e6
-        if ev.kind == "span":
-            if ev.name.startswith("skew."):
-                # straggler attribution renders ONE LANE PER CHIP — the
-                # per-executor timeline, with compute and collective-wait
-                # spans stacked per device (obs/_skew.py)
-                pid, tid = PID_SKEW, int(ev.args.get("device", 0))
-                label = "device"
-            else:
-                pid, tid = (PID_DEVICE if _is_device_span(ev)
-                            else PID_HOST), ev.tid
-                label = ("dispatch-thread" if pid == PID_DEVICE
-                         else "host-thread")
-            key = (pid, tid)
-            if key not in seen_tids:
-                seen_tids.add(key)
-                out.append({"ph": "M", "pid": pid, "tid": tid,
-                            "name": "thread_name",
-                            "args": {"name": f"{label}-{tid}"}})
-            out.append({"ph": "X", "pid": pid, "tid": tid,
-                        "ts": ts_us, "dur": max((ev.dur or 0.0), 0.0) * 1e6,
-                        "name": ev.name, "cat": ev.kind,
-                        "args": dict(ev.args)})
-        elif ev.kind == "counter":
-            if _is_counter_track(ev.name):
-                out.append({"ph": "C", "pid": PID_HOST, "tid": 0,
-                            "ts": ts_us, "name": ev.name, "cat": "counter",
-                            "args": {"value": ev.args.get("total", 0.0)}})
-        else:
-            # every other typed event (dispatch, cache, collective,
-            # compile, serve, infer, skew, health, regress, ...) renders
-            # as an instant marker: a visible pin without a lane
-            out.append({"ph": "i", "s": "t", "pid": PID_HOST,
-                        "tid": ev.tid, "ts": ts_us, "name": ev.name,
-                        "cat": ev.kind, "args": dict(ev.args)})
-    return out
+    return to_trace_dicts(_as_records(events))
 
 
 def export_chrome_trace(path: str) -> str:
     """Write the recorder's current ring as a Chrome trace; returns the
-    path (so callers can log it as a tracking artifact)."""
-    doc = {"traceEvents": to_trace_events(RECORDER.events()),
-           "displayTimeUnit": "ms",
-           "otherData": {"producer": "sml_tpu.obs",
-                         "dropped_events": RECORDER.dropped}}
+    path (so callers can log it as a tracking artifact). The document's
+    otherData carries `epoch_unix` — the wall-clock instant of ts 0 —
+    so the timeline correlates with external logs (PR 8 satellite)."""
+    doc = trace_doc(_as_records(RECORDER.events()),
+                    dropped=RECORDER.dropped,
+                    epoch_unix=RECORDER.epoch_unix())
     with open(path, "w") as f:
         json.dump(doc, f, default=str)
     return path

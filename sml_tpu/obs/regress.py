@@ -88,8 +88,13 @@ def normalize(doc: dict) -> dict:
             "pass_walls": [float(x) for x in
                            (doc.get("timed_pass_walls") or [])],
             "legs": legs,
+            # non-numeric metric values are ANNOTATIONS, not perf
+            # numbers (the serve_worst_trace trace-id exemplar PR 8
+            # added): skipped here so the sentry neither crashes on
+            # them nor flags them as coverage drift
             "metrics": {k: float(v) for k, v in
-                        (doc.get("metrics") or {}).items()},
+                        (doc.get("metrics") or {}).items()
+                        if isinstance(v, (int, float))},
             "multichip": doc.get("multichip"),
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}

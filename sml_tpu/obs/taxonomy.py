@@ -35,6 +35,10 @@ SPANS = {
 }
 
 COUNTERS = {
+    # stall watchdog (obs/_watchdog.py): flagged in-flight tickets
+    "stall.*",
+    # black-box postmortem (obs/blackbox.py): bundles written
+    "blackbox.*",
     "staging.cache_hit", "staging.cache_miss",
     "staging.bin_cache_hit", "staging.bin_cache_miss",
     "staging.h2d_bytes", "staging.d2h_bytes", "staging.h2d_bytes_saved",
@@ -90,6 +94,18 @@ EVENTS = {
                           # RECORDER.emit path
     "health.*",           # health.snapshot (engine_health() receipts)
     "regress.*",          # regress.verdict (bench_diff annotations)
+    # causal tracing (obs/_context.py): trace.request admission spans
+    # (emitted as kind="span" so the exporter lands them on the
+    # admitting thread's lane — the flow arrows' source anchor). Trace
+    # ids themselves are not names: they ride event args ("trace",
+    # "span", "parent_traces", "parent_spans") and METRICS observations
+    # as per-bucket EXEMPLARS, so no registry entry can rot
+    "trace.*",
+    # stall watchdog (obs/_watchdog.py): stall.detected (with all-thread
+    # stack snapshot args) / stall.resolved
+    "stall.*",
+    # black-box postmortem (obs/blackbox.py): blackbox.dump receipts
+    "blackbox.*",
 }
 
 # streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs import _context as _trace
 from ..obs._recorder import RECORDER as _OBS
+from ..obs._watchdog import WATCHDOG as _WATCHDOG
 from .mesh import DATA_AXIS
 
 
@@ -49,11 +51,17 @@ def _note(op: str, x=None) -> None:
     `collective.<op>_bytes` (rendered as a counter track by the trace
     exporter): the ICI allreduce volume of one split round is the
     histogram payload, and the histogram-subtraction trick's halving of
-    it is directly visible in this counter."""
+    it is directly visible in this counter.
+
+    Tracing happens on the DISPATCHING thread, so the causal trace
+    context riding it (obs/_context.py — e.g. a coalesced serving
+    flush, a fused CV trial batch) tags the event: the collective hop
+    of a request's causal chain, without a device profiler."""
     if _OBS.enabled:
         nbytes = None if x is None else _payload_bytes(x)
         _OBS.emit("collective", f"collective.{op}",
-                  args=None if nbytes is None else {"bytes": nbytes})
+                  args=_trace.trace_args(
+                      None if nbytes is None else {"bytes": nbytes}))
         _OBS.counter(f"collective.{op}")
         if nbytes:
             _OBS.counter(f"collective.{op}_bytes", nbytes)
@@ -127,6 +135,12 @@ def initialize_multihost(coordinator: Optional[str] = None, num_processes: Optio
     (the NCCL/MPI-equivalent bootstrap, without either)."""
     if num_processes is None or num_processes <= 1:
         return
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    # the one HOST-SIDE collective wait in this module: bring-up blocks
+    # until every process joins, which is exactly the hang a dead peer
+    # produces — a watchdog ticket makes it a flagged stall with stacks
+    # instead of a silent wedge (obs/_watchdog.py)
+    with _WATCHDOG.watch("collective", "collective.initialize",
+                         trace=_trace.current()):
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)

@@ -40,7 +40,9 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..conf import GLOBAL_CONF, _register, _to_bool
+from ..obs import _context as _trace
 from ..obs._recorder import RECORDER as _OBS
+from ..obs._watchdog import WATCHDOG as _WATCHDOG
 from ..utils.profiler import PROFILER, now as _now
 
 _register("sml.prewarm.enabled", False, _to_bool,
@@ -196,8 +198,16 @@ def _replay_one(entry: dict, stats: dict, stats_lock) -> None:
     _tls.replaying = True
     t0 = _now()
     ok = True
+    # each replay is its own causal trace (obs/_context.py): the rebuild
+    # + first-dispatch spans it triggers carry the replay's trace id,
+    # and a replay wedged behind a dead tunnel registers as an in-flight
+    # watchdog ticket instead of silently pinning a pool worker
+    ctx = _trace.new_trace()
     try:
-        _REBUILDERS[entry["kind"]](entry["meta"])
+        with _trace.activate(ctx), \
+                _WATCHDOG.watch("prewarm", f"prewarm.{entry['kind']}",
+                                trace=ctx):
+            _REBUILDERS[entry["kind"]](entry["meta"])
     except Exception:
         ok = False
     finally:
@@ -211,9 +221,10 @@ def _replay_one(entry: dict, stats: dict, stats_lock) -> None:
     else:
         PROFILER.count("prewarm.failed")
     if _OBS.enabled:
-        _OBS.emit("prewarm", "prewarm.replay",
-                  args={"kind": entry["kind"], "ok": ok,
-                        "seconds": round(dt, 4)})
+        args = {"kind": entry["kind"], "ok": ok, "seconds": round(dt, 4)}
+        if ctx is not None:
+            args["trace"] = ctx.trace_id
+        _OBS.emit("prewarm", "prewarm.replay", args=args)
 
 
 def prewarm(workers: Optional[int] = None) -> dict:

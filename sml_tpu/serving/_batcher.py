@@ -36,8 +36,10 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..conf import GLOBAL_CONF
+from ..obs import _context as _trace
 from ..obs._metrics import METRICS as _METRICS
 from ..obs._recorder import RECORDER as _OBS
+from ..obs._watchdog import WATCHDOG as _WATCHDOG
 from ..parallel import dispatch
 from ..utils.profiler import PROFILER, now
 
@@ -49,13 +51,17 @@ class RequestShed(RuntimeError):
 
 class ScoreFuture:
     """Handle for one submitted request: `result()` blocks for the
-    per-request prediction slice (or raises what the batch raised)."""
+    per-request prediction slice (or raises what the batch raised).
+    `trace_id` is the request's causal trace id (obs/_context.py) — the
+    handle clients and tests use to find THIS request in an exported
+    Chrome trace; None with the recorder off."""
 
     def __init__(self, n_rows: int):
         self._event = threading.Event()
         self._n_rows = n_rows
         self._value: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self.trace_id: Optional[int] = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -77,7 +83,7 @@ class ScoreFuture:
 
 
 class _Pending:
-    __slots__ = ("X", "n", "future", "t_enqueue", "deadline")
+    __slots__ = ("X", "n", "future", "t_enqueue", "deadline", "ctx")
 
     def __init__(self, X: np.ndarray, deadline: Optional[float]):
         self.X = X
@@ -85,6 +91,12 @@ class _Pending:
         self.future = ScoreFuture(self.n)
         self.t_enqueue = now()
         self.deadline = deadline
+        # causal trace context minted at ADMISSION (obs/_context.py):
+        # lands a trace.request span on the admitting thread and rides
+        # the queue to the coalesced flush — the cross-queue handoff
+        self.ctx = _trace.mint_request(rows=self.n, ts=self.t_enqueue)
+        self.future.trace_id = None if self.ctx is None \
+            else self.ctx.trace_id
 
 
 class MicroBatcher:
@@ -194,8 +206,11 @@ class MicroBatcher:
             try:
                 pending.future._set(np.asarray(
                     self._host_score(pending.X), dtype=np.float64))
-                _METRICS.observe("serve.request_ms",
-                                 (now() - pending.t_enqueue) * 1e3)
+                _METRICS.observe(
+                    "serve.request_ms",
+                    (now() - pending.t_enqueue) * 1e3,
+                    exemplar=None if pending.ctx is None
+                    else pending.ctx.trace_id)
             except BaseException as e:  # noqa: BLE001 — future carries it
                 pending.future._set_error(e)
             return pending.future
@@ -284,10 +299,22 @@ class MicroBatcher:
         # the shape-grid pad the staged block will carry (bucket_rows's
         # coarse grid; the mesh may round further for per-chip equality)
         pad = dispatch.bucket_rows(total, 1) - total
+        # the FAN-IN edge (obs/_context.py): N request contexts merge
+        # into one flush context; the flush span records every parent
+        # span/trace id, and the flush context rides into the dispatch
+        # decision, program span, and collective notes downstream
+        parents = [p.ctx for p in live if p.ctx is not None]
+        bctx = _trace.fan_in(parents)
+        fan_meta = {} if bctx is None else {
+            "parent_traces": _trace.parent_traces(parents),
+            "parent_spans": _trace.parent_ids(parents)}
+        ticket = _WATCHDOG.open("serve.flush", "serve.batch", trace=bctx)
         try:
-            with PROFILER.span("serve.batch", rows=total,
-                               requests=len(live)):
-                out = np.asarray(self._score_block(X), dtype=np.float64)
+            with _trace.activate(bctx):
+                with PROFILER.span("serve.batch", rows=total,
+                                   requests=len(live), **fan_meta):
+                    out = np.asarray(self._score_block(X),
+                                     dtype=np.float64)
             PROFILER.count("serve.batches")
             # rows that actually entered a device batch — the occupancy
             # numerator (serve.rows also counts shed/host-routed admissions)
@@ -302,11 +329,17 @@ class MicroBatcher:
                 # per-request latency (admission -> result) into the
                 # streaming metrics core: serve percentiles and the SLO
                 # burn-rate come from this histogram, never from raw
-                # sample lists (bench.py's sort path is gone)
+                # sample lists (bench.py's sort path is gone). The
+                # request's OWN trace id is the observation's exemplar
+                # (no bleed from batch mates) — the worst histogram
+                # bucket names a literal request
                 _METRICS.observe("serve.request_ms",
-                                 (done - p.t_enqueue) * 1e3)
+                                 (done - p.t_enqueue) * 1e3,
+                                 exemplar=None if p.ctx is None
+                                 else p.ctx.trace_id)
         except BaseException as e:  # noqa: BLE001 — futures carry it
             for p in live:
                 p.future._set_error(e)
         finally:
+            _WATCHDOG.close(ticket)
             dispatch.DEVICE_QUEUE.sub(total)

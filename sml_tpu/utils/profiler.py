@@ -18,7 +18,9 @@ from typing import Dict, Iterator, List, Optional
 
 from ..conf import GLOBAL_CONF
 from ..obs import _audit as _obs_audit
+from ..obs import _context as _obs_ctx
 from ..obs._recorder import RECORDER as _OBS
+from ..obs._watchdog import WATCHDOG as _OBS_WATCHDOG
 
 
 def now() -> float:
@@ -90,13 +92,26 @@ class Profiler:
 
         Runs when the profiler OR the flight recorder is on; the recorder
         additionally gets a timestamped span event (for the Chrome trace)
-        and, for spans carrying a dispatch `route`, feeds the measured
-        wall time back to the dispatch audit."""
+        tagged with the riding trace context (obs/_context.py), and, for
+        spans carrying a dispatch `route`, registers a stall-watchdog
+        ticket (expected wall = the audit's prediction for this thread's
+        pending decision) and feeds the measured wall time back to the
+        dispatch audit."""
         prof_on = self.enabled
         obs_on = _OBS.enabled
         if not prof_on and not obs_on:
             yield
             return
+        route = meta.get("route")
+        ticket = None
+        if obs_on and route in ("host", "device"):
+            # a dispatch launch in flight: the watchdog flags it if it
+            # exceeds stallFactor x its own predicted wall (floor
+            # stallMillis) — obs/_watchdog.py
+            ticket = _OBS_WATCHDOG.open(
+                "dispatch", name,
+                expected_s=_obs_audit.expected_wall(route),
+                trace=_obs_ctx.current())
         if prof_on:
             gen = self._gen
             tls = self._tls
@@ -111,6 +126,7 @@ class Profiler:
             yield
         finally:
             dt = time.perf_counter() - t0
+            _OBS_WATCHDOG.close(ticket)
             if prof_on:
                 if self._gen == gen:
                     stack.pop()
@@ -123,8 +139,13 @@ class Profiler:
                 # else: reset() fired mid-span — this span's timing
                 # straddles it and the stack was invalidated; drop both
             if obs_on and _OBS.enabled:
-                _OBS.span(name, t0, dt, rows=rows, **meta)
-                route = meta.get("route")
+                ctx = _obs_ctx.current()
+                if ctx is not None and "trace" not in meta:
+                    _OBS.span(name, t0, dt, rows=rows,
+                              trace=ctx.trace_id, span=ctx.span_id,
+                              **meta)
+                else:
+                    _OBS.span(name, t0, dt, rows=rows, **meta)
                 if route in ("host", "device"):
                     _obs_audit.attach(route, name, dt)
 

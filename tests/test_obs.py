@@ -268,6 +268,33 @@ def test_ledger_alloc_free_and_peaks():
 
 
 # ----------------------------------------------------- ring + sink mechanics
+def test_tid_map_bounded_under_short_lived_threads(recorder):
+    """Satellite: the thread-id -> dense-tid map must not grow forever
+    under serving's short-lived client threads — past _MAX_TIDS, dead
+    threads' slots are reclaimed and reused."""
+    import threading
+
+    from sml_tpu.obs._recorder import _MAX_TIDS
+
+    def emit_once(i):
+        obs.RECORDER.emit("cache", "cache.test", args={"i": i})
+
+    for i in range(_MAX_TIDS + 90):
+        t = threading.Thread(target=emit_once, args=(i,))
+        t.start()
+        t.join()
+    assert len(obs.RECORDER._tids) <= _MAX_TIDS + 1, \
+        "dead-thread tid slots leaked"
+    # reclaimed lanes stay DENSE: no tid ever exceeded the bound
+    tids = {e.tid for e in obs.RECORDER.events()
+            if e.name == "cache.test"}
+    assert max(tids) < _MAX_TIDS + 1
+    # and the newest emits were recorded (reuse, not refusal)
+    seen = {e.args["i"] for e in obs.RECORDER.events()
+            if e.name == "cache.test"}
+    assert _MAX_TIDS + 89 in seen
+
+
 def test_ring_is_bounded_and_counts_drops(recorder):
     GLOBAL_CONF.set("sml.obs.ringEvents", 32)
     for i in range(100):
@@ -398,6 +425,33 @@ def test_disabled_recorder_costs_one_attribute_load():
     assert per_note < 20e-6, f"{per_note * 1e6:.2f}us per disabled note"
     assert obs.SKEW.programs() == []
     assert obs.straggler_report() is None
+    # trace context (PR 8): disabled current()/mint/fan_in return None
+    # behind one attribute load — no ContextVar read, no allocation
+    from sml_tpu.obs import _context
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _context.current()
+    per_ctx = (time.perf_counter() - t0) / n
+    assert per_ctx < 20e-6, f"{per_ctx * 1e6:.2f}us per disabled current"
+    assert _context.current() is None
+    assert _context.mint_request(rows=1) is None
+    assert _context.fan_in([]) is None
+    assert obs.RECORDER.events() == []  # mint emitted nothing
+    # stall watchdog (PR 8): disabled open() registers nothing, starts
+    # no thread, and costs one attribute load
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.WATCHDOG.open("dispatch", "program.noop")
+    per_open = (time.perf_counter() - t0) / n
+    assert per_open < 20e-6, f"{per_open * 1e6:.2f}us per disabled open"
+    assert obs.WATCHDOG.report()["open"] == 0
+    # exemplar-carrying observe: same disabled contract as plain observe
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.METRICS.observe("serve.request_ms", 1.5, exemplar=12345)
+    per_ex = (time.perf_counter() - t0) / n
+    assert per_ex < 20e-6, f"{per_ex * 1e6:.2f}us per disabled exemplar"
+    assert obs.METRICS.histogram("serve.request_ms") is None
 
 
 # -------------------------------------------------------- profiler reset fix
