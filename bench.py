@@ -1044,6 +1044,137 @@ def multichip_main(rows: int) -> None:
     }))
 
 
+# ---------------------------------------------------------- kernelbench leg
+KERNELBENCH_ROWS = 60_000
+KERNELBENCH_TREES = 8
+
+
+def run_kernelbench(rows: int = KERNELBENCH_ROWS) -> dict:
+    """`--kernelbench`: the fused-kernel sweep (ISSUE 9) — the same
+    bootstrap-forest fit across a maxBins × maxDepth grid, timed once
+    under `sml.tree.kernel=xla` (the one-hot dot + cumsum HLO chain) and
+    once under `=pallas` (the fused `native/hist_kernel.py` bin-accumulate
+    + split-scan launches), best-of-3 warm fits per leg with the compile
+    paid in a warmup fit.
+
+    Per leg the sidecar records both walls, the ratio, the per-path
+    `kernel.*` counter deltas captured from the warmup trace
+    (pallas_launch/interpret are trace-time statics, like collective.*),
+    and a bit-parity check of the two paths' predictions. On non-TPU
+    backends the pallas path runs in INTERPRET mode — those numbers
+    measure emulation overhead, not kernel speed (the `interpret` flag in
+    the block says which kind of run this is); `obs/regress.py` judges
+    `kernel.fallback` growth across committed sidecars as a regression
+    either way. Results merge into the bench sidecar as the `kernel`
+    block, rendered by scripts/render_perf.py."""
+    import jax
+
+    from sml_tpu import obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml._tree_models import _fit_ensemble
+
+    rng = np.random.default_rng(9)
+    F = 10
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] ** 2 + 0.3 * X[:, 3]
+         + rng.normal(0, 0.3, rows)).astype(np.float32)
+    probe = X[:4096]
+
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    prev_kernel = GLOBAL_CONF.get("sml.tree.kernel")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    legs = []
+    try:
+        for max_bins in (32, 128):
+            for max_depth in (4, 6):
+                entry = {"max_bins": max_bins, "max_depth": max_depth}
+                counters = {}
+                preds = {}
+                for path in ("xla", "pallas"):
+                    GLOBAL_CONF.set("sml.tree.kernel", path)
+
+                    def fit():
+                        return _fit_ensemble(
+                            X, y, categorical={}, max_depth=max_depth,
+                            max_bins=max_bins, min_instances=1,
+                            min_info_gain=0.0, n_trees=KERNELBENCH_TREES,
+                            feature_k=None, bootstrap=True, subsample=1.0,
+                            seed=7, loss="squared")
+
+                    obs.reset()
+                    spec = fit()  # warmup: compile + trace-time counters
+                    snap = obs.RECORDER.counters()
+                    for k, v in snap.items():
+                        if k.startswith(("kernel.", "tree.fit_dispatch")):
+                            counters[f"{path}:{k}"] = float(v)
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        fit()
+                        best = min(best, time.perf_counter() - t0)
+                    entry[f"{path}_s"] = round(best, 4)
+                    preds[path] = spec.predict_margin(probe)
+                entry["pallas_vs_xla"] = round(
+                    entry["xla_s"] / entry["pallas_s"], 3)
+                entry["parity_ok"] = bool(
+                    np.array_equal(preds["xla"], preds["pallas"]))
+                entry["kernel_counters"] = {
+                    "kernel.pallas_launch":
+                        counters.get("pallas:kernel.pallas_launch", 0.0),
+                    "kernel.interpret":
+                        counters.get("pallas:kernel.interpret", 0.0),
+                    "kernel.fallback":
+                        counters.get("pallas:kernel.fallback", 0.0)
+                        + counters.get("xla:kernel.fallback", 0.0),
+                }
+                legs.append(entry)
+                print(f"  kernel b{max_bins} d{max_depth}: "
+                      f"xla {entry['xla_s']:.3f}s, pallas "
+                      f"{entry['pallas_s']:.3f}s "
+                      f"({entry['pallas_vs_xla']}x, parity="
+                      f"{entry['parity_ok']}, launches "
+                      f"{entry['kernel_counters']['kernel.pallas_launch']:.0f})",
+                      file=sys.stderr)
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
+        GLOBAL_CONF.set("sml.tree.kernel", prev_kernel)
+    return {
+        "rows": rows, "n_features": F, "n_trees": KERNELBENCH_TREES,
+        "backend": jax.default_backend(),
+        "interpret": jax.default_backend() != "tpu",
+        "note": "best-of-3 warm fits per (maxBins, maxDepth, path); "
+                "kernel.* counters are per-TRACE statics from the warmup "
+                "fit; on non-TPU backends the pallas path runs in "
+                "interpret mode (parity, not speed — see docs/KERNELS.md)",
+        "legs": legs,
+    }
+
+
+def kernelbench_main(rows: int) -> None:
+    """Run the kernel sweep standalone, merge the `kernel` block into the
+    bench sidecar, and print the short headline JSON last."""
+    block = run_kernelbench(rows)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["kernel"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    best = max(e["pallas_vs_xla"] for e in block["legs"])
+    print(json.dumps({
+        "metric": "fused-kernel sweep (pallas vs xla)",
+        "value": best,
+        "unit": "x vs xla path (best leg)",
+        "backend": block["backend"],
+        "interpret": block["interpret"],
+        "parity_ok": all(e["parity_ok"] for e in block["legs"]),
+        "fallbacks": sum(e["kernel_counters"]["kernel.fallback"]
+                         for e in block["legs"]),
+        "legs_file": "bench_legs.json",
+    }))
+
+
 # ----------------------------------------------------------------- goldens
 def check_goldens(metrics):
     """Compare this run's metric values against the CPU-mesh 1M-row pins
@@ -1338,6 +1469,19 @@ def main():
                     for k, v in metrics.items()},
         "legs": per_leg,
     }
+    # the standalone-leg blocks (--multichip / --kernelbench) merge into
+    # this sidecar from their own runs: carry them across a plain suite
+    # run instead of silently dropping them — bench_diff treats a
+    # vanished kernel block as coverage loss
+    if os.path.exists(LEGS_FILE):
+        try:
+            with open(LEGS_FILE) as f:
+                prev_doc = json.load(f)
+            for block in ("multichip", "kernel"):
+                if block in prev_doc and block not in sidecar:
+                    sidecar[block] = prev_doc[block]
+        except (OSError, ValueError):
+            pass
     with open(LEGS_FILE, "w") as f:
         json.dump(sidecar, f, indent=1)
 
@@ -1394,6 +1538,16 @@ if __name__ == "__main__":
                              "device_count=8)")
     parser.add_argument("--multichip-rows", type=int, default=MULTICHIP_ROWS,
                         help="row count for the --multichip leg")
+    parser.add_argument("--kernelbench", action="store_true",
+                        help="run ONLY the fused-kernel sweep (maxBins × "
+                             "maxDepth, sml.tree.kernel=pallas vs =xla, "
+                             "best-of-3 warm fits) and merge the `kernel` "
+                             "block into the bench sidecar; on non-TPU "
+                             "backends the pallas path runs in interpret "
+                             "mode (parity, not speed)")
+    parser.add_argument("--kernelbench-rows", type=int,
+                        default=KERNELBENCH_ROWS,
+                        help="row count for the --kernelbench leg")
     parser.add_argument("--lint", action="store_true",
                         help="gate the run on a clean graftlint pass: a "
                              "bench record from a tree violating engine "
@@ -1416,7 +1570,9 @@ if __name__ == "__main__":
         sys.exit(1)
     entry = (pin_goldens if args.pin_goldens else
              (lambda: multichip_main(args.multichip_rows))
-             if args.multichip else main)
+             if args.multichip else
+             (lambda: kernelbench_main(args.kernelbench_rows))
+             if args.kernelbench else main)
     if args.blackbox_on_fail:
         from sml_tpu.conf import GLOBAL_CONF as _CONF1
         from sml_tpu.obs import blackbox as _blackbox

@@ -8,11 +8,17 @@ stay authoritative. A bare `jax.jit` / `pjit` / `pmap` anywhere else is a
 compile the observability stack never sees.
 
 Flagged forms (call or decorator):  `jax.jit(...)`, `pjit(...)`,
-`jax.pmap(...)`, `@jax.jit`, `@partial(jax.jit, ...)`.
+`jax.pmap(...)`, `@jax.jit`, `@partial(jax.jit, ...)` — and raw Pallas
+kernel launches, `pl.pallas_call(...)` / `pallas_call(...)`: a custom
+kernel is a compile AND a device launch the routing audit, the
+`kernel.*` counters, and the interpret-mode fallback ladder must govern,
+so kernels live only in the sanctioned `sml_tpu/native/` module
+(docs/KERNELS.md).
 
 Suppression is an explicit ALLOWLIST of (file, enclosing function)
-pairs, each carrying its justification — the blessed compile owners —
-plus the usual pragma/baseline machinery for one-offs.
+pairs — or a directory prefix ending in "/" — each carrying its
+justification (the blessed compile owners), plus the usual
+pragma/baseline machinery for one-offs.
 """
 
 from __future__ import annotations
@@ -23,13 +29,25 @@ from typing import Dict, List, Optional
 from ..core import Violation, rule
 from ..project import Project
 
-COMPILE_ATTRS = {"jit", "pjit", "pmap"}
+COMPILE_ATTRS = {"jit", "pjit", "pmap"}  # jax.<attr> spellings only;
+# pallas_call matches by attribute/name directly in _is_jax_jit_expr
+# (its qualifier is a caller-chosen import alias, never `jax`)
 
-#: rel -> {enclosing qualname ("<module>" for module level) -> reason}
+#: rel (or directory prefix ending in "/") ->
+#: {enclosing qualname ("<module>" for module level) -> reason}
 ALLOWLIST: Dict[str, Dict[str, str]] = {
     "sml_tpu/parallel/dispatch.py": {
         "*": "the dispatcher itself: calibration probes and the compile "
              "cache are this rule's ground truth",
+    },
+    "sml_tpu/native/": {
+        # form-scoped entry: blesses ONLY pallas_call launches (counted
+        # via kernel.pallas_launch/kernel.interpret and governed by
+        # tree_impl._kernel_choice's fallback ladder — docs/KERNELS.md);
+        # a bare jax.jit added under native/ still flags like anywhere
+        "form:pallas_call": "THE sanctioned custom-kernel module: every "
+                            "pallas_call here is counted and "
+                            "fallback-governed",
     },
     "sml_tpu/ml/_staging.py": {
         "data_parallel": "THE blessed jit+shard_map compile helper; every "
@@ -53,12 +71,17 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
 
 
 def _is_jax_jit_expr(e: ast.expr) -> bool:
-    """jax.jit / jax.pjit / jax.pmap as an attribute, or a bare pjit name."""
+    """jax.jit / jax.pjit / jax.pmap as an attribute, a bare pjit name,
+    or a Pallas launch: `pl.pallas_call` / `pallas.pallas_call` (any
+    qualifier — the import alias is caller-chosen) / bare
+    `pallas_call`."""
     if isinstance(e, ast.Attribute):
+        if e.attr == "pallas_call":
+            return True
         return (isinstance(e.value, ast.Name) and e.value.id == "jax"
                 and e.attr in COMPILE_ATTRS)
     if isinstance(e, ast.Name):
-        return e.id in ("pjit",)
+        return e.id in ("pjit", "pallas_call")
     return False
 
 
@@ -86,6 +109,11 @@ def check(project: Project) -> List[Violation]:
         if f.tree is None:
             continue
         allow = ALLOWLIST.get(f.rel, {})
+        if not allow:  # directory-prefix entries (sml_tpu/native/)
+            for pref, entry in ALLOWLIST.items():
+                if pref.endswith("/") and f.rel.startswith(pref):
+                    allow = entry
+                    break
         if "*" in allow:
             continue
 
@@ -96,12 +124,20 @@ def check(project: Project) -> List[Violation]:
                 qual = fn.qualname if fn else "<module>"
             if qual in allow or qual.rsplit(".", 1)[-1] in allow:
                 return
+            # form-scoped entries bless one compile FORM file-wide
+            # (the native/ directory blesses pallas_call, not jax.jit)
+            if "pallas_call" in label and "form:pallas_call" in allow:
+                return
+            fix = ("move the kernel into sml_tpu/native/ (the sanctioned "
+                   "kernel module behind tree_impl._kernel_choice)"
+                   if "pallas_call" in label else
+                   "compile through ml._staging.data_parallel/"
+                   "cached_data_parallel")
             out.append(Violation(
                 "dispatch-bypass", f.rel, node.lineno,
                 f"bare `{label}` compile in `{qual}` bypasses "
                 f"parallel.dispatch (routing audit + obs.note_compile + "
-                f"compile cache never see it) — compile through "
-                f"ml._staging.data_parallel/cached_data_parallel or add "
+                f"compile cache never see it) — {fix} or add "
                 f"an allowlist entry with a reason"))
 
         seen_decorators = set()

@@ -285,6 +285,26 @@ def bin_cache_stats() -> dict:
                 "bytes": _bin_stage_bytes[0]}
 
 
+@contextlib.contextmanager
+def transient_hbm(pool: str, nbytes: int):
+    """Account a dispatch's dominant TRANSIENT device working set in the
+    HBM ledger for the duration of the call (alloc on entry, free on
+    exit) — live/peak visibility for program-internal buffers the staging
+    caches never own. The tree fit paths charge the XLA path's fit-long
+    one-hot resident (`hist_onehot`) through this; the pallas kernel path
+    charges zero, so the ledger shows the bytes the kernel keeps out of
+    HBM. No-ops on nbytes <= 0."""
+    if nbytes <= 0:
+        yield
+        return
+    from ..obs import LEDGER
+    LEDGER.alloc(pool, int(nbytes))
+    try:
+        yield
+    finally:
+        LEDGER.free(pool, int(nbytes))
+
+
 def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
     """device_put a row-sharded array through the content cache."""
     from ..utils.profiler import PROFILER

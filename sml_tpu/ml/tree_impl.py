@@ -37,7 +37,7 @@ import numpy as np
 
 from ..parallel import collectives as coll
 from ..parallel import mesh as meshlib
-from ._staging import data_parallel, stage_sharded
+from ._staging import data_parallel, stage_sharded, transient_hbm
 
 
 class TreeSpec(NamedTuple):
@@ -231,11 +231,102 @@ def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+#: id(mesh) -> (mesh, platform). The entry HOLDS the mesh so a recycled
+#: id() after garbage collection can never serve a stale platform (the
+#: hit path re-checks identity); meshes are few and small per process.
+_platform_memo: Dict[int, tuple] = {}
+
+
+def _mesh_platform(mesh=None) -> str:
+    """The active mesh's device platform, memoized per mesh identity:
+    `_hist_dtype` and `_kernel_choice` both run inside every fit setup,
+    and walking `mesh.devices.flat` allocates a fresh device list per
+    call. Mesh identity keys the memo (a new/rebuilt mesh re-probes);
+    conf is deliberately NOT part of the memo — knobs like
+    `sml.tree.kernel` are read fresh by their own resolvers on top of
+    the memoized platform, so a conf change takes effect immediately."""
+    mesh = mesh or meshlib.get_mesh()
+    key = id(mesh)
+    hit = _platform_memo.get(key)
+    if hit is not None and hit[0] is mesh:
+        return hit[1]
+    plat = str(list(mesh.devices.flat)[0].platform)
+    _platform_memo[key] = (mesh, plat)
+    return plat
+
+
 def _hist_dtype():
     """bf16 histogram operands on TPU (exact one-hot, f32 accumulation on
     the MXU); f32 elsewhere — XLA:CPU has no bf16xbf16=f32 dot."""
-    plat = list(meshlib.get_mesh().devices.flat)[0].platform
-    return jnp.bfloat16 if plat == "tpu" else jnp.float32
+    return jnp.bfloat16 if _mesh_platform() == "tpu" else jnp.float32
+
+
+def _kernel_choice() -> str:
+    """Resolve `sml.tree.kernel` to the concrete build path ("pallas" /
+    "xla") for the ACTIVE mesh — the resolved value is part of every
+    tree-program cache key and rides the prewarm manifest so replay
+    rebuilds the same executable.
+
+    Fallback ladder (docs/KERNELS.md): 'xla' short-circuits; 'pallas'
+    requires the toolchain probe (`native.hist_kernel.available`) and
+    otherwise falls back to xla counting `kernel.fallback`; 'auto' only
+    ever selects pallas on a real TPU mesh (interpret-mode emulation is
+    an explicit opt-in via 'pallas', never a default on CPU)."""
+    from ..conf import GLOBAL_CONF
+    from ..utils.profiler import PROFILER
+    mode = str(GLOBAL_CONF.get("sml.tree.kernel")).strip().lower()
+    if mode not in ("auto", "pallas", "xla"):
+        # a typo must not silently land on either path (on TPU an
+        # unknown value would otherwise behave like 'auto' = pallas)
+        raise ValueError(
+            f"sml.tree.kernel must be one of auto/pallas/xla, got {mode!r}")
+    if mode == "xla":
+        return "xla"
+    if mode == "auto" and _mesh_platform() != "tpu":
+        return "xla"  # auto: never emulate on non-TPU backends
+    from ..native import hist_kernel as _hk
+    if _hk.available():
+        return "pallas"
+    PROFILER.count("kernel.fallback")
+    return "xla"
+
+
+#: compiled split_scan holds the whole per-level (F, B, width, 3) f32
+#: histogram as ONE un-gridded VMEM block; past this budget it cannot
+#: lower on real hardware (~16 MB VMEM/core, shared with the operands)
+_SCAN_VMEM_BUDGET = 8 << 20
+
+
+def _kernel_for(spec: TreeSpec) -> str:
+    """Per-fit kernel resolution: `_kernel_choice` plus a STATIC shape
+    guard for the compiled path — the split-scan kernel takes the whole
+    widest-level histogram (F · bins · 2^(depth-1) · 3 f32) as one VMEM
+    block, so specs past `_SCAN_VMEM_BUDGET` demote to xla with a
+    `kernel.fallback` count instead of failing to lower mid-trace on
+    real TPU (`available()` only proves the toolchain imports; it cannot
+    probe every shape). Interpret mode has no VMEM and never demotes."""
+    kernel = _kernel_choice()
+    if kernel == "pallas" and _mesh_platform() == "tpu":
+        width = 2 ** max(spec.max_depth - 1, 0)
+        hist_bytes = spec.n_features * spec.n_bins * width * 3 * 4
+        if hist_bytes > _SCAN_VMEM_BUDGET:
+            from ..utils.profiler import PROFILER
+            PROFILER.count("kernel.fallback")
+            return "xla"
+    return kernel
+
+
+def _kernel_block_rows(kernel: str) -> int:
+    """Resolved `sml.tree.kernelBlockRows` for pallas programs (0 on the
+    XLA path, which has no block scheme). Read ONCE per program build and
+    carried in every tree program cache key AND the prewarm manifest —
+    toggling the knob must compile a fresh executable, not silently
+    replay one traced under the old block scheme (the same contract
+    `sml.tpu.donate` and `sml.tree.histSubtraction` already honor)."""
+    if kernel != "pallas":
+        return 0
+    from ..conf import GLOBAL_CONF
+    return GLOBAL_CONF.getInt("sml.tree.kernelBlockRows")
 
 
 def _hist_subtract() -> bool:
@@ -244,7 +335,8 @@ def _hist_subtract() -> bool:
 
 
 def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
-                       subtract: bool = True):
+                       subtract: bool = True, kernel: str = "xla",
+                       block_rows: int = 0):
     """Pure per-chip tree-build fn (called inside shard_map): one level-wise
     pass, histograms as one-hot dots, psum merges. Returns stacked node
     arrays as a single (5, n_nodes) f32 pack (one transfer, one scan slot).
@@ -269,11 +361,36 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
     grid-fused batching path): the loop still unrolls to spec.max_depth,
     but splits are gated off at level >= dyn.depth, so a shallower trial
     produces the tree its own static program would have (deeper nodes
-    keep zero cover and inherit the parent value)."""
+    keep zero cover and inherit the parent value).
+
+    `kernel="pallas"` swaps the histogram dot and the gain scan for the
+    fused `native/hist_kernel.py` launches (bin-accumulate straight from
+    the compact `binned_c` operand — callers pass B1t=None — then the
+    in-register split scan on the post-psum histogram); the psum, the
+    histogram-subtraction gating, the RF-subspace draw, and the row
+    routing stay in the shared glue, so per-chip partials and randomness
+    are identical to the XLA path. On non-TPU platforms the kernels run
+    in interpret mode (single row block — bit-parity with this very
+    function's XLA branch, asserted by tests/test_hist_kernel.py)."""
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
     n_nodes = 2 ** (D + 1) - 1
+    use_pallas = kernel == "pallas"
+    if use_pallas:
+        from ..native import hist_kernel as _hk
+        interp = _mesh_platform() != "tpu"
+        if not interp and block_rows:
+            # the accumulate kernel's per-block one-hot tile is
+            # block_rows·F·B·itemsize of VMEM: clamp the conf target to
+            # the same budget the split-scan guard enforces, so an
+            # oversized tile shrinks the block instead of failing to
+            # lower (the conf value stays the cache key — this clamp is
+            # a pure function of (spec, conf), both already keyed)
+            per_row = F * B * np.dtype(hist_dtype).itemsize
+            block_rows = max(
+                min(block_rows, _SCAN_VMEM_BUDGET // max(per_row, 1)), 8)
 
-    def build(B1t, binned, grad, hess, weight, feat_rng, dyn=None):
+    def build(B1t, binned, grad, hess, weight, feat_rng, dyn=None,
+              binned_c=None):
         min_inst = spec.min_instances if dyn is None else dyn.min_instances
         min_gain = spec.min_info_gain if dyn is None else dyn.min_info_gain
         n = binned.shape[0]
@@ -305,27 +422,34 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
                 half = width // 2
                 is_left = (lid_c % 2) == 0
                 wl = jnp.where(is_left, wq, 0.0)
-                node1hot = jax.nn.one_hot(lid_c // 2, half,
-                                          dtype=hist_dtype) \
-                    * (wl > 0)[:, None].astype(hist_dtype)
-                stats_l = jnp.stack([grad * wl, hess * wl, wl], axis=1)
-                ns = (node1hot[:, :, None]
-                      * stats_l[:, None, :].astype(hist_dtype)
-                      ).reshape(n, half * 3)
+                hw, lid_h, w_eff = half, lid_c // 2, wl
             else:
-                stats = jnp.stack([grad * wq, hess * wq, wq], axis=1)
-                node1hot = jax.nn.one_hot(lid_c, width, dtype=hist_dtype) \
-                    * (wq > 0)[:, None].astype(hist_dtype)
+                hw, lid_h, w_eff = width, lid_c, wq
+            if use_pallas:
+                # fused bin-accumulate straight from the compact bin
+                # cache operand: the one-hot tiles live only in VMEM
+                part = _hk.hist_accumulate(
+                    binned if binned_c is None else binned_c,
+                    lid_h, grad, hess, w_eff, n_bins=B, n_slots=hw,
+                    hist_dtype=hist_dtype, interpret=interp,
+                    block_rows=block_rows or None)
+            else:
+                node1hot = jax.nn.one_hot(lid_h, hw, dtype=hist_dtype) \
+                    * (w_eff > 0)[:, None].astype(hist_dtype)
+                stats = jnp.stack([grad * w_eff, hess * w_eff, w_eff],
+                                  axis=1)
                 ns = (node1hot[:, :, None]
                       * stats[:, None, :].astype(hist_dtype)
-                      ).reshape(n, width * 3)
-            # bf16 operands (the one-hot side is EXACT in bf16), f32
-            # accumulation: the MXU's native mode. B1t is pre-transposed
-            # OUTSIDE the tree scan — a .T here would re-materialize a
-            # ~1GB transpose every level of every tree
-            hist = coll.psum(jax.lax.dot_general(
-                B1t, ns, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))
+                      ).reshape(n, hw * 3)
+                # bf16 operands (the one-hot side is EXACT in bf16), f32
+                # accumulation: the MXU's native mode. B1t is
+                # pre-transposed OUTSIDE the tree scan — a .T here would
+                # re-materialize a ~1GB transpose every level of every
+                # tree
+                part = jax.lax.dot_general(
+                    B1t, ns, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            hist = coll.psum(part)
             if subtract and level > 0:
                 half = width // 2
                 left = hist.reshape(F, B, half, 3)
@@ -338,47 +462,70 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
                     .reshape(F, B, width, 3)
             else:
                 hist = hist.reshape(F, B, width, 3)
-            hG = jnp.transpose(hist[..., 0], (2, 0, 1))              # (width,F,B)
-            hH = jnp.transpose(hist[..., 1], (2, 0, 1))
-            hW = jnp.transpose(hist[..., 2], (2, 0, 1))
-            GL = jnp.cumsum(hG, axis=2)
-            HL = jnp.cumsum(hH, axis=2)
-            WL = jnp.cumsum(hW, axis=2)
-            G = GL[:, :, -1:]
-            H = HL[:, :, -1:]
-            W = WL[:, :, -1:]
-            lam = spec.reg_lambda
-            score = (GL ** 2 / (HL + lam + 1e-12)
-                     + (G - GL) ** 2 / (H - HL + lam + 1e-12)
-                     - G ** 2 / (H + lam + 1e-12))
-            ok = ((WL >= min_inst)
-                  & ((W - WL) >= min_inst))
-            ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
             if dyn is not None or spec.feature_k < F:
                 # under dyn the draw ALWAYS happens (feature_k is traced);
                 # with feature_k == F the mask is all-True, so a
                 # no-subspace trial sees the identical candidate set its
-                # own static program (which skips the draw) produces
+                # own static program (which skips the draw) produces. The
+                # draw stays OUTSIDE the pallas kernel so both paths
+                # consume the same randomness
                 u = jax.random.uniform(
                     jax.random.fold_in(jax.random.wrap_key_data(feat_rng), level),
                     (width, F))
                 ranks = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
                 fk = spec.feature_k if dyn is None else dyn.feature_k
-                ok = ok & (ranks < fk)[:, :, None]
-            score = jnp.where(ok, score, -jnp.inf)
-            flat_best = jnp.argmax(score.reshape(width, F * B), axis=1)
-            best_f = (flat_best // B).astype(jnp.int32)
-            best_b = (flat_best % B).astype(jnp.int32)
-            best_gain = 0.5 * jnp.take_along_axis(
-                score.reshape(width, F * B), flat_best[:, None], axis=1)[:, 0] \
-                - spec.gamma
+                fmask = ranks < fk                             # (width, F)
+            else:
+                fmask = None
+            if use_pallas:
+                # fused split scan: cumsum + gain + masks + argmax in one
+                # kernel on the post-psum histogram; only the (6, width)
+                # best-split pack leaves it
+                pack6 = _hk.split_scan(
+                    hist,
+                    jnp.ones((width, F), jnp.float32) if fmask is None
+                    else fmask.astype(jnp.float32),
+                    jnp.asarray(min_inst, jnp.float32).reshape(1, 1),
+                    reg_lambda=spec.reg_lambda, gamma=spec.gamma,
+                    interpret=interp)
+                best_f = pack6[0].astype(jnp.int32)
+                best_b = pack6[1].astype(jnp.int32)
+                best_gain = pack6[2]
+                gG, gH, gW = pack6[3], pack6[4], pack6[5]
+            else:
+                hG = jnp.transpose(hist[..., 0], (2, 0, 1))          # (width,F,B)
+                hH = jnp.transpose(hist[..., 1], (2, 0, 1))
+                hW = jnp.transpose(hist[..., 2], (2, 0, 1))
+                GL = jnp.cumsum(hG, axis=2)
+                HL = jnp.cumsum(hH, axis=2)
+                WL = jnp.cumsum(hW, axis=2)
+                G = GL[:, :, -1:]
+                H = HL[:, :, -1:]
+                W = WL[:, :, -1:]
+                lam = spec.reg_lambda
+                score = (GL ** 2 / (HL + lam + 1e-12)
+                         + (G - GL) ** 2 / (H - HL + lam + 1e-12)
+                         - G ** 2 / (H + lam + 1e-12))
+                ok = ((WL >= min_inst)
+                      & ((W - WL) >= min_inst))
+                ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
+                if fmask is not None:
+                    ok = ok & fmask[:, :, None]
+                score = jnp.where(ok, score, -jnp.inf)
+                flat_best = jnp.argmax(score.reshape(width, F * B), axis=1)
+                best_f = (flat_best // B).astype(jnp.int32)
+                best_b = (flat_best % B).astype(jnp.int32)
+                best_gain = 0.5 * jnp.take_along_axis(
+                    score.reshape(width, F * B), flat_best[:, None],
+                    axis=1)[:, 0] - spec.gamma
+                gG, gH, gW = G[:, 0, 0], H[:, 0, 0], W[:, 0, 0]
             do_split = (best_gain > min_gain) & jnp.isfinite(best_gain)
             if dyn is not None:  # trial's own maxDepth: no splits beyond it
                 do_split = do_split & (level < dyn.depth)
             idx = base + jnp.arange(width)
-            node_G = node_G.at[idx].set(G[:, 0, 0])
-            node_H = node_H.at[idx].set(H[:, 0, 0])
-            node_W = node_W.at[idx].set(W[:, 0, 0])
+            node_G = node_G.at[idx].set(gG)
+            node_H = node_H.at[idx].set(gH)
+            node_W = node_W.at[idx].set(gW)
             split_feature = split_feature.at[idx].set(
                 jnp.where(do_split, best_f, -1))
             split_bin = split_bin.at[idx].set(best_b)
@@ -494,7 +641,8 @@ def _sliced_draw(n: int, data_width: int, draw):
     return jax.lax.dynamic_slice(full, (coll.axis_index() * n,), (n,))
 
 
-def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1):
+def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1,
+                     kernel: str = "xla", block_rows: int = 0):
     """The shared internals of every ensemble program shape: `prepare`
     widens the compact quantized bins on-device and hoists the one-hot
     transpose; `make_round` returns the per-round scan body. Factored so
@@ -502,26 +650,36 @@ def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1):
     math — a parity test holds them together. `data_width` is the mesh's
     STATIC data-axis size (part of every program cache's mesh-id key):
     sampling draws span `local_rows * data_width` so every layout selects
-    the same global weights (see `_sliced_draw`)."""
+    the same global weights (see `_sliced_draw`). Under
+    `kernel="pallas"` the fit-long B1t one-hot resident is never built
+    (B1t=None) — the pallas kernel one-hots VMEM bin tiles per row block
+    from the COMPACT operand instead."""
     spec = es.tree
     hist_dtype = _hist_dtype()
-    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
+                               kernel=kernel, block_rows=block_rows)
     B, F = spec.n_bins, spec.n_features
 
     def prepare(binned, rng):
         n = binned.shape[0]
         # compact uint8/uint16 bins widen ON-DEVICE (a fused VPU cast over
-        # the 4x-smaller staged matrix), never on the host/H2D path
+        # the 4x-smaller staged matrix), never on the host/H2D path; the
+        # compact operand survives alongside — the kernel path histograms
+        # straight from it
+        binned_c = binned
         binned = binned.astype(jnp.int32)
-        B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
-            .reshape(n, F * B).T  # transposed ONCE, reused by every tree
+        if kernel == "pallas":
+            B1t = None  # kernel one-hots bin tiles in VMEM per block
+        else:
+            B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
+                .reshape(n, F * B).T  # transposed ONCE, reused every tree
         # ONE replicated sampling stream (fold_in(0) preserves the
         # historical single-device draws bit-for-bit); per-chip weights
         # come from slicing the global draw, not from per-chip keys
         key = jax.random.fold_in(jax.random.wrap_key_data(rng), 0)
-        return binned, B1t, key
+        return binned, binned_c, B1t, key
 
-    def make_round(binned, B1t, y, mask, key, rng):
+    def make_round(binned, binned_c, B1t, y, mask, key, rng):
         n = binned.shape[0]
 
         def round_fn(margin, t):
@@ -548,7 +706,8 @@ def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1):
             w = w * mask
             feat_rng = jax.random.key_data(jax.random.fold_in(
                 jax.random.wrap_key_data(rng), t))  # same across chips
-            pack, node_fin = build(B1t, binned, grad, hess, w, feat_rng)
+            pack, node_fin = build(B1t, binned, grad, hess, w, feat_rng,
+                                   binned_c=binned_c)
             if es.boosting:
                 # the build routed every row to its terminal node already:
                 # the margin update is one gather, not a depth-long re-walk
@@ -568,35 +727,39 @@ def _data_width(mesh=None) -> int:
     return int(mesh.shape.get(meshlib.DATA_AXIS, 1))
 
 
-def _make_ensemble_program(es: EnsembleSpec, data_width: int = 1):
+def _make_ensemble_program(es: EnsembleSpec, data_width: int = 1,
+                           kernel: str = "xla", block_rows: int = 0):
     """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
     trees, margins and sampling weights living in HBM for the entire fit.
     One dispatch + one packed device→host transfer per ensemble — the
     per-tree host round-trips (expensive over a TPU tunnel) disappear."""
-    prepare, make_round = _ensemble_pieces(es, data_width)
+    prepare, make_round = _ensemble_pieces(es, data_width, kernel,
+                                           block_rows)
     base_of = _base_margin_fn(es.loss)
 
     def program(binned, y, mask, rng):
-        binned, B1t, key = prepare(binned, rng)
+        binned, binned_c, B1t, key = prepare(binned, rng)
         base = base_of(y, mask)
         margin0 = jnp.full((binned.shape[0],), base, dtype=jnp.float32)
-        round_fn = make_round(binned, B1t, y, mask, key, rng)
+        round_fn = make_round(binned, binned_c, B1t, y, mask, key, rng)
         _, packs = jax.lax.scan(round_fn, margin0, jnp.arange(es.n_trees))
         return packs, base
 
     return program
 
 
-def _make_chunk_program(es: EnsembleSpec, chunk: int, data_width: int = 1):
+def _make_chunk_program(es: EnsembleSpec, chunk: int, data_width: int = 1,
+                        kernel: str = "xla", block_rows: int = 0):
     """`chunk` boosting rounds as one dispatch: the margin carry enters and
     leaves as a row-sharded HBM buffer (donated between dispatches by the
     caller), `t0` offsets the round index so sampling streams and feature
     subspaces match the monolithic scan round-for-round."""
-    prepare, make_round = _ensemble_pieces(es, data_width)
+    prepare, make_round = _ensemble_pieces(es, data_width, kernel,
+                                           block_rows)
 
     def program(binned, y, mask, margin, rng, t0):
-        binned, B1t, key = prepare(binned, rng)
-        round_fn = make_round(binned, B1t, y, mask, key, rng)
+        binned, binned_c, B1t, key = prepare(binned, rng)
+        round_fn = make_round(binned, binned_c, B1t, y, mask, key, rng)
         margin, packs = jax.lax.scan(
             round_fn, margin, t0 + jnp.arange(chunk, dtype=jnp.int32))
         return margin, packs
@@ -608,24 +771,30 @@ _chunk_cache: Dict[tuple, object] = {}
 _base_prog_cache: Dict[tuple, object] = {}
 
 
-def _compiled_chunk(es: EnsembleSpec, chunk: int):
+def _compiled_chunk(es: EnsembleSpec, chunk: int,
+                    kernel: Optional[str] = None,
+                    block_rows: Optional[int] = None):
     from ..parallel import mesh as _meshlib
     from ..conf import GLOBAL_CONF
     mesh = _meshlib.get_mesh()
+    kernel = kernel or _kernel_for(es.tree)
+    brows = _kernel_block_rows(kernel) if block_rows is None \
+        else int(block_rows)
     # donate the margin carry so chunk k+1 reuses chunk k's HBM (the
     # chain's only fresh buffer — bins/labels/mask stay cache-owned
     # and are never donated); XLA:CPU ignores donation, so skip it
     # there to avoid the unused-donation warning. The donate decision is
     # part of the cache key: toggling sml.tpu.donate must not replay a
     # program compiled under the other setting.
-    plat = list(mesh.devices.flat)[0].platform
+    plat = _mesh_platform(mesh)
     donate = (3,) if plat != "cpu" \
         and GLOBAL_CONF.getBool("sml.tpu.donate") else ()
-    key = (es, chunk, id(mesh), _hist_subtract(), donate)
+    key = (es, chunk, id(mesh), _hist_subtract(), donate, kernel, brows)
     if key not in _chunk_cache:
         from ..obs import note_compile
         note_compile(f"tree_chunk_{chunk}")
-        program = _make_chunk_program(es, chunk, _data_width(mesh))
+        program = _make_chunk_program(es, chunk, _data_width(mesh), kernel,
+                                      brows)
         P = jax.sharding.PartitionSpec
         Dx = _meshlib.DATA_AXIS
         wrapped = _meshlib.shard_map_compat(
@@ -637,13 +806,15 @@ def _compiled_chunk(es: EnsembleSpec, chunk: int):
 
 
 def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
-                          seed: int, chunk: int):
+                          seed: int, chunk: int,
+                          kernel: Optional[str] = None):
     """Boosting rounds in ceil(n_trees/chunk) dispatches. The margin never
     visits the host between chunks — it carries as a donated device buffer
     — and per-chunk tree packs are fetched once at the end (one batched
     D2H). Bit-identical to the monolithic program on equal backends."""
     from ..parallel import mesh as _meshlib
     mesh = _meshlib.get_mesh()
+    kernel = kernel or _kernel_for(es.tree)
     bkey = (es.loss, id(mesh))
     if bkey not in _base_prog_cache:
         _base_prog_cache[bkey] = data_parallel(_base_margin_fn(es.loss))
@@ -662,18 +833,21 @@ def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
         rng = jax.random.key_data(jax.random.PRNGKey(seed))
         packs_parts = []
         t0 = 0
-        while t0 < es.n_trees:
-            c = min(chunk, es.n_trees - t0)
-            _prewarm.record("tree_chunk", {
-                "es": _es_meta(es), "chunk": int(c),
-                "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev,
-                                           margin)})
-            PROFILER.count("tree.fit_dispatch")
-            margin, packs = _compiled_chunk(es, c)(
-                binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
-            packs_parts.append(packs)
-            t0 += c
-        packs = np.concatenate(jax.device_get(packs_parts), axis=0)
+        with transient_hbm("hist_onehot",
+                           _onehot_bytes(es.tree, binned_dev.shape[0], kernel)):
+            while t0 < es.n_trees:
+                c = min(chunk, es.n_trees - t0)
+                _prewarm.record("tree_chunk", {
+                    "es": _es_meta(es), "chunk": int(c), "kernel": kernel,
+                    "kernel_rows": _kernel_block_rows(kernel),
+                    "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev,
+                                               margin)})
+                PROFILER.count("tree.fit_dispatch")
+                margin, packs = _compiled_chunk(es, c, kernel)(
+                    binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
+                packs_parts.append(packs)
+                t0 += c
+            packs = np.concatenate(jax.device_get(packs_parts), axis=0)
     finally:
         LEDGER.free("boost_margin", margin_bytes)
     return _unpack_trees(packs), base
@@ -696,38 +870,64 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                                        rounds_per_dispatch)
 
 
-def _ensemble_compiled(es: EnsembleSpec):
+def _ensemble_compiled(es: EnsembleSpec, kernel: Optional[str] = None,
+                       block_rows: Optional[int] = None):
     """The monolithic whole-ensemble program from its per-mesh cache —
     shared by the fit path and the prewarm rebuilder (warming must
-    populate the SAME cache entry the fit will hit)."""
-    key = (es, id(meshlib.get_mesh()), _hist_subtract())
+    populate the SAME cache entry the fit will hit). `kernel` is the
+    RESOLVED build path ("pallas"/"xla"): part of the cache key, and
+    replay passes the manifest-recorded value so a prewarm rebuilds the
+    executable the fit actually compiled."""
+    kernel = kernel or _kernel_for(es.tree)
+    brows = _kernel_block_rows(kernel) if block_rows is None \
+        else int(block_rows)
+    key = (es, id(meshlib.get_mesh()), _hist_subtract(), kernel, brows)
     if key not in _ensemble_cache:
         from ..obs import note_compile
         note_compile("tree_ensemble")
         _ensemble_cache[key] = data_parallel(
-            _make_ensemble_program(es, _data_width()),
+            _make_ensemble_program(es, _data_width(), kernel, brows),
             replicated_argnums=(3,))
     return _ensemble_cache[key]
+
+
+def _onehot_bytes(spec: TreeSpec, rows: int, kernel: str) -> int:
+    """HBM bytes of the XLA path's fit-long one-hot resident (`B1t`: rows
+    × F × bins in hist_dtype) — the dominant transient the ledger charges
+    for the duration of a tree-fit dispatch (every tree program shape,
+    fit_tree included). The pallas kernel path never materializes it (bin
+    tiles one-hot in VMEM per row block), so its charge is zero: the
+    `hbm.hist_onehot_bytes` gauge difference IS the kernel's residency
+    win."""
+    if kernel == "pallas":
+        return 0
+    return int(rows) * spec.n_features * spec.n_bins \
+        * np.dtype(_hist_dtype()).itemsize
 
 
 def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                             seed: int = 0,
                             rounds_per_dispatch: Optional[int] = None):
     from ..conf import GLOBAL_CONF
+    kernel = _kernel_for(es.tree)
     rounds = (rounds_per_dispatch if rounds_per_dispatch is not None
               else GLOBAL_CONF.getInt("sml.tree.roundsPerDispatch"))
     if es.boosting and 0 < rounds < es.n_trees:
         return _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es,
-                                     seed, rounds)
-    compiled = _ensemble_compiled(es)
+                                     seed, rounds, kernel)
+    compiled = _ensemble_compiled(es, kernel)
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
     from ..parallel import prewarm as _prewarm
     from ..utils.profiler import PROFILER
     _prewarm.record("tree_ensemble", {
-        "es": _es_meta(es),
+        "es": _es_meta(es), "kernel": kernel,
+        "kernel_rows": _kernel_block_rows(kernel),
         "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev)})
     PROFILER.count("tree.fit_dispatch")
-    packs, base = jax.device_get(compiled(binned_dev, y_dev, mask_dev, rng))
+    with transient_hbm("hist_onehot",
+                       _onehot_bytes(es.tree, binned_dev.shape[0], kernel)):
+        packs, base = jax.device_get(compiled(binned_dev, y_dev, mask_dev,
+                                              rng))
     # ^ one batched D2H transfer for (packs, base): the tunnel charges a
     # fixed latency per transfer, so never fetch leaves separately
     return _unpack_trees(packs), float(base)
@@ -817,30 +1017,39 @@ def fit_ensembles_folds(bst, yst, mst, es: EnsembleSpec, seed: int = 0):
     y_dev = stage_stacked_cached(yst)
     m_dev = stage_stacked_cached(mst)
 
-    compiled = _folds_compiled(es, fo)
+    kernel = _kernel_for(es.tree)
+    compiled = _folds_compiled(es, fo, kernel)
     from ..parallel import prewarm as _prewarm
     _prewarm.record("tree_folds", {
-        "es": _es_meta(es), "fo": int(fo),
+        "es": _es_meta(es), "fo": int(fo), "kernel": kernel,
+        "kernel_rows": _kernel_block_rows(kernel),
         "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
     with PROFILER.span(
             "program.tree_ensemble_folds", rows=int(fo * n_pad),
             route="host" if _dispatch.is_host_mesh(mesh) else "device",
-            trees=es.n_trees * fo):
+            trees=es.n_trees * fo), \
+            transient_hbm("hist_onehot",
+                          _onehot_bytes(es.tree, fo * n_pad, kernel)):
         PROFILER.count("tree.fit_dispatch")
         packs, bases = jax.device_get(compiled(b_dev, y_dev, m_dev, rng))
     return [(_unpack_trees(packs[k]), float(bases[k])) for k in range(fo)]
 
 
-def _folds_compiled(es: EnsembleSpec, fo: int):
+def _folds_compiled(es: EnsembleSpec, fo: int, kernel: Optional[str] = None,
+                    block_rows: Optional[int] = None):
     """The fold-batched program from its per-mesh cache (shared with the
     prewarm rebuilder)."""
     mesh = meshlib.get_mesh()
-    key = (es, fo, id(mesh), _hist_subtract())
+    kernel = kernel or _kernel_for(es.tree)
+    brows = _kernel_block_rows(kernel) if block_rows is None \
+        else int(block_rows)
+    key = (es, fo, id(mesh), _hist_subtract(), kernel, brows)
     if key not in _folds_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_folds_{fo}")
-        program = _make_ensemble_program(es, _data_width(mesh))
+        program = _make_ensemble_program(es, _data_width(mesh), kernel,
+                                         brows)
 
         def batched(binned_f, y_f, mask_f, rng):
             return jax.vmap(program, in_axes=(0, 0, 0, None))(
@@ -860,7 +1069,8 @@ def _folds_compiled(es: EnsembleSpec, fo: int):
 _trials_cache: Dict[tuple, object] = {}
 
 
-def _make_trials_program(es: EnsembleSpec, data_width: int = 1):
+def _make_trials_program(es: EnsembleSpec, data_width: int = 1,
+                         kernel: str = "xla", block_rows: int = 0):
     """Per-ELEMENT ensemble program with TRACED hyperparameters, vmapped
     over the trial axis by `fit_ensembles_trials`: `es` carries the grid
     MAXIMA as static shapes (max_depth, n_bins, n_trees), and each
@@ -873,16 +1083,21 @@ def _make_trials_program(es: EnsembleSpec, data_width: int = 1):
     trial-sharded one, whose data axis is only n_dev/trial_dim wide)."""
     spec = es.tree
     hist_dtype = _hist_dtype()
-    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
+                               kernel=kernel, block_rows=block_rows)
     B, F = spec.n_bins, spec.n_features
     base_of = _base_margin_fn(es.loss)
 
     def program(binned, y, mask, rng, depth, feature_k, min_inst, mig,
                 bootstrap, subsample):
         n = binned.shape[0]
+        binned_c = binned
         binned = binned.astype(jnp.int32)
-        B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
-            .reshape(n, F * B).T
+        if kernel == "pallas":
+            B1t = None  # kernel one-hots bin tiles in VMEM per block
+        else:
+            B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype) \
+                .reshape(n, F * B).T
         key = jax.random.fold_in(jax.random.wrap_key_data(rng), 0)
         base = base_of(y, mask)
         dyn = TrialDyn(depth=depth, feature_k=feature_k,
@@ -901,7 +1116,8 @@ def _make_trials_program(es: EnsembleSpec, data_width: int = 1):
                           jnp.where(subsample < 1.0, bern, ones)) * mask
             feat_rng = jax.random.key_data(jax.random.fold_in(
                 jax.random.wrap_key_data(rng), t))
-            pack, _ = build(B1t, binned, grad, hess, w, feat_rng, dyn=dyn)
+            pack, _ = build(B1t, binned, grad, hess, w, feat_rng, dyn=dyn,
+                            binned_c=binned_c)
             return carry, pack
 
         _, packs = jax.lax.scan(round_fn, 0.0, jnp.arange(es.n_trees))
@@ -910,7 +1126,9 @@ def _make_trials_program(es: EnsembleSpec, data_width: int = 1):
     return program
 
 
-def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None):
+def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None,
+                     kernel: Optional[str] = None,
+                     block_rows: Optional[int] = None):
     """The trial-batched program from its per-mesh cache (shared with the
     prewarm rebuilder). Cache key carries only STATIC maxima — a grid
     whose per-trial values change but whose maxima land on the same
@@ -920,11 +1138,15 @@ def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None):
     replicating, and each trial lane's histogram psums span only its own
     n_dev/trial_dim-wide data axis."""
     mesh = mesh or meshlib.get_mesh()
-    key = (es, n_elems, id(mesh), _hist_subtract())
+    kernel = kernel or _kernel_for(es.tree)
+    brows = _kernel_block_rows(kernel) if block_rows is None \
+        else int(block_rows)
+    key = (es, n_elems, id(mesh), _hist_subtract(), kernel, brows)
     if key not in _trials_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_trials_{n_elems}")
-        program = _make_trials_program(es, _data_width(mesh))
+        program = _make_trials_program(es, _data_width(mesh), kernel,
+                                       brows)
 
         def batched(binned_e, y_e, mask_e, rngs, *dyns):
             return jax.vmap(program,
@@ -1034,6 +1256,7 @@ def fit_ensembles_trials(bst, yst, mst, es: EnsembleSpec, rngs,
 
     mesh = meshlib.get_mesh()
     E, n_pad = bst.shape[0], bst.shape[1]
+    kernel = _kernel_for(es.tree)
     tdim = _trial_axis_width(E, n_pad)
     dyns = [np.asarray(depth, np.int32), np.asarray(feature_k, np.int32),
             np.asarray(min_inst, np.float32),
@@ -1049,20 +1272,23 @@ def fit_ensembles_trials(bst, yst, mst, es: EnsembleSpec, rngs,
         b_dev = stage_trial_stacked_cached(bst, tmesh)
         y_dev = stage_trial_stacked_cached(yst, tmesh)
         m_dev = stage_trial_stacked_cached(mst, tmesh)
-        compiled = _trials_compiled(es, e_pad, tmesh)
+        compiled = _trials_compiled(es, e_pad, tmesh, kernel)
     else:
         e_pad = E
         b_dev = stage_stacked_cached(bst)
         y_dev = stage_stacked_cached(yst)
         m_dev = stage_stacked_cached(mst)
-        compiled = _trials_compiled(es, E)
+        compiled = _trials_compiled(es, E, kernel=kernel)
     _prewarm.record("tree_trials", {
         "es": _es_meta(es), "n_elems": int(e_pad), "trial_dim": int(tdim),
+        "kernel": kernel, "kernel_rows": _kernel_block_rows(kernel),
         "args": _prewarm.arg_specs(b_dev, y_dev, m_dev)})
     with PROFILER.span(
             "program.tree_ensemble_trials", rows=int(e_pad * n_pad),
             route="host" if _dispatch.is_host_mesh(mesh) else "device",
-            trees=es.n_trees * e_pad):
+            trees=es.n_trees * e_pad), \
+            transient_hbm("hist_onehot",
+                          _onehot_bytes(es.tree, e_pad * n_pad, kernel)):
         PROFILER.count("tree.fit_dispatch")
         packs, bases = jax.device_get(compiled(
             b_dev, y_dev, m_dev, rngs, *dyns))
@@ -1111,18 +1337,32 @@ def _replay_zeros(meta, n: int):
     return out
 
 
+def _replay_kernel(meta: dict) -> tuple:
+    """(kernel, block_rows) as recorded in the manifest: replay must
+    rebuild the SAME executable the recorded fit compiled — flag AND
+    block scheme — regardless of the replaying process's live conf.
+    Pre-kernel manifests carry neither — those resolve live (None)."""
+    k = meta.get("kernel")
+    k = str(k) if k in ("pallas", "xla") else None
+    r = meta.get("kernel_rows")
+    r = int(r) if k is not None and isinstance(r, (int, float)) else None
+    return k, r
+
+
 def _replay_tree_ensemble(meta: dict) -> None:
     es = _es_from_meta(meta)
     b, y, m = _replay_zeros(meta, 3)
     rng = jax.random.key_data(jax.random.PRNGKey(0))
-    jax.device_get(_ensemble_compiled(es)(b, y, m, rng))
+    jax.device_get(_ensemble_compiled(es, *_replay_kernel(meta))(
+        b, y, m, rng))
 
 
 def _replay_tree_chunk(meta: dict) -> None:
     es = _es_from_meta(meta)
     b, y, m, margin = _replay_zeros(meta, 4)
     rng = jax.random.key_data(jax.random.PRNGKey(0))
-    jax.device_get(_compiled_chunk(es, int(meta["chunk"]))(
+    jax.device_get(_compiled_chunk(es, int(meta["chunk"]),
+                                   *_replay_kernel(meta))(
         b, y, m, margin, rng, jnp.int32(0)))
 
 
@@ -1130,7 +1370,8 @@ def _replay_tree_folds(meta: dict) -> None:
     es = _es_from_meta(meta)
     b, y, m = _replay_zeros(meta, 3)
     rng = jax.random.key_data(jax.random.PRNGKey(0))
-    jax.device_get(_folds_compiled(es, int(meta["fo"]))(b, y, m, rng))
+    jax.device_get(_folds_compiled(es, int(meta["fo"]),
+                                   *_replay_kernel(meta))(b, y, m, rng))
 
 
 def _replay_tree_trials(meta: dict) -> None:
@@ -1150,10 +1391,11 @@ def _replay_tree_trials(meta: dict) -> None:
             arrs.append(jax.device_put(
                 a, jax.sharding.NamedSharding(tmesh, spec)))
         b, y, m = arrs
-        compiled = _trials_compiled(es, E, tmesh)
+        compiled = _trials_compiled(es, E, tmesh, *_replay_kernel(meta))
     else:
         b, y, m = _replay_zeros(meta, 3)
-        compiled = _trials_compiled(es, E)
+        kk, kr = _replay_kernel(meta)
+        compiled = _trials_compiled(es, E, kernel=kk, block_rows=kr)
     rngs = np.zeros((E, 2), np.uint32)
     jax.device_get(compiled(
         b, y, m, rngs,
@@ -1174,16 +1416,24 @@ def _register_prewarm_rebuilders() -> None:
 _register_prewarm_rebuilders()
 
 
-def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32):
+def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32,
+                        kernel: str = "xla", block_rows: int = 0):
     """Single-tree program (kept for the dryrun/compile-check path)."""
     B, F = spec.n_bins, spec.n_features
-    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract())
+    build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
+                               kernel=kernel, block_rows=block_rows)
 
     def program(binned, grad, hess, weight, feat_rng):
         n = binned.shape[0]
+        binned_c = binned
         binned = binned.astype(jnp.int32)  # compact bins widen on-device
-        B1t = jax.nn.one_hot(binned, B, dtype=hist_dtype).reshape(n, F * B).T
-        pack, _ = build(B1t, binned, grad, hess, weight, feat_rng)
+        if kernel == "pallas":
+            B1t = None
+        else:
+            B1t = jax.nn.one_hot(binned, B,
+                                 dtype=hist_dtype).reshape(n, F * B).T
+        pack, _ = build(B1t, binned, grad, hess, weight, feat_rng,
+                        binned_c=binned_c)
         return (pack[0].astype(jnp.int32), pack[1].astype(jnp.int32),
                 pack[2], pack[3], pack[4])
 
@@ -1197,19 +1447,24 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
              rng: int = 0, feat_key: Optional[np.ndarray] = None) -> FittedTree:
     """Build one tree on the mesh from pre-staged device arrays."""
     from ..parallel import mesh as _meshlib
-    key = (spec, id(_meshlib.get_mesh()), _hist_subtract())
+    kernel = _kernel_for(spec)
+    brows = _kernel_block_rows(kernel)
+    key = (spec, id(_meshlib.get_mesh()), _hist_subtract(), kernel, brows)
     if key not in _tree_cache:
         from ..obs import note_compile
         note_compile("tree_single")
         _tree_cache[key] = data_parallel(
-            _build_tree_program(spec, _hist_dtype()), replicated_argnums=(4,))
+            _build_tree_program(spec, _hist_dtype(), kernel, brows),
+            replicated_argnums=(4,))
     compiled = _tree_cache[key]
     if feat_key is None:
         feat_key = jax.random.key_data(jax.random.PRNGKey(rng))
     from ..utils.profiler import PROFILER
     PROFILER.count("tree.fit_dispatch")
-    out = compiled(binned_dev, grad_dev, hess_dev, weight_dev, feat_key)
-    sf, sb, lv, g, cov = jax.device_get(out)  # one batched transfer
+    with transient_hbm("hist_onehot",
+                       _onehot_bytes(spec, binned_dev.shape[0], kernel)):
+        out = compiled(binned_dev, grad_dev, hess_dev, weight_dev, feat_key)
+        sf, sb, lv, g, cov = jax.device_get(out)  # one batched transfer
     sf, lv = sf.copy(), lv.copy()
     # nodes never reached in training (zero cover) inherit the parent value so
     # unseen routes at predict time fall back gracefully

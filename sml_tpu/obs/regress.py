@@ -50,10 +50,13 @@ BYTES_FLOOR = 1 << 20
 
 #: per-leg engine counters the sentry judges, with their growth bound:
 #: ("count", slack) = cand may exceed base by max(1, slack*base);
-#: ("bytes", rel) = cand may exceed base by rel (volumes >= BYTES_FLOOR)
+#: ("bytes", rel) = cand may exceed base by rel (volumes >= BYTES_FLOOR);
+#: ("exact", _) = ANY growth flags (kernel fallbacks: a fit silently
+#: degrading from the pallas path to XLA is a perf regression even by 1)
 COUNTER_CHECKS = {
     "compile.programs": ("count", 0.0),
     "tree.fit_dispatch": ("count", 0.0),
+    "kernel.fallback": ("exact", 0.0),
     "staging.h2d_bytes": ("bytes", 0.25),
     "staging.d2h_bytes": ("bytes", 0.25),
     "collective.psum_bytes": ("bytes", STATIC_TOL),
@@ -96,6 +99,8 @@ def normalize(doc: dict) -> dict:
                         (doc.get("metrics") or {}).items()
                         if isinstance(v, (int, float))},
             "multichip": doc.get("multichip"),
+            "kernel": doc.get("kernel"),
+            "shape": "sidecar",
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
     parsed = doc.get("parsed") or {}
@@ -118,6 +123,8 @@ def normalize(doc: dict) -> dict:
         "legs": legs,
         "metrics": metrics,
         "multichip": mc,
+        "kernel": doc.get("kernel"),
+        "shape": "record",
     }
 
 
@@ -183,10 +190,26 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
         for key, (mode, slack) in COUNTER_CHECKS.items():
             bv = b["counters"].get(key)
             cv = c["counters"].get(key)
+            if mode == "exact":
+                # absence means zero, not "unjudgeable": legs only record
+                # counters that fired, so the realistic regression is
+                # exactly 0 (key absent in base) -> N (present in cand)
+                bv = 0.0 if bv is None else bv
+                cv = 0.0 if cv is None else cv
             if bv is None or cv is None:
                 continue
             checked += 1
-            if mode == "count":
+            if mode == "exact":
+                if cv > bv:
+                    reg.append(_finding(
+                        "leg-counter", f"{name}:{key}", bv, cv, 0.0,
+                        "regression",
+                        "kernel fallback count grew — fits silently "
+                        "degrading off the pallas path"))
+                elif cv < bv:
+                    imp.append(_finding("leg-counter", f"{name}:{key}",
+                                        bv, cv, 0.0, "improvement"))
+            elif mode == "count":
                 bound = bv + max(1.0, slack * bv)
                 if cv > bound:
                     reg.append(_finding(
@@ -255,6 +278,50 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                             "multichip-collective", f"{w}dev:{key}", bv,
                             cv, slack, "regression",
                             "per-trace collective static grew"))
+
+    # ---- kernelbench block (pallas vs xla sweep + kernel.* counters)
+    bk, ck = base.get("kernel"), cand.get("kernel")
+    if bk and not ck and cand.get("shape") != "record":
+        # same coverage rule as ordinary legs: the gate silently
+        # vanishing IS the regression (bench.py carries the block across
+        # plain suite runs, so a SIDECAR candidate missing it actually
+        # lost it; BENCH_r0x driver records can never carry the block,
+        # so they are exempt — like the multichip both-present rule)
+        reg.append(_finding(
+            "missing-kernel-block", "kernel", 1.0, 0.0, 0.0, "regression",
+            "kernelbench block present in base, absent in candidate"))
+    if bk and ck:
+        ckl = {(int(e["max_bins"]), int(e["max_depth"])): e
+               for e in ck.get("legs", [])}
+        for e in bk.get("legs", []):
+            ce = ckl.get((int(e["max_bins"]), int(e["max_depth"])))
+            tag = f"b{e['max_bins']}d{e['max_depth']}"
+            if ce is None:
+                reg.append(_finding(
+                    "missing-kernel-leg", tag, 1.0, 0.0, 0.0,
+                    "regression",
+                    "sweep leg present in base, absent in candidate"))
+                continue
+            # any fallback growth = fits silently leaving the pallas path
+            bf = float((e.get("kernel_counters") or {})
+                       .get("kernel.fallback", 0.0))
+            cf = float((ce.get("kernel_counters") or {})
+                       .get("kernel.fallback", 0.0))
+            checked += 1
+            if cf > bf:
+                reg.append(_finding(
+                    "kernel-fallback", tag, bf, cf, 0.0, "regression",
+                    "kernel.fallback grew — pallas path silently lost"))
+            for key in ("pallas_s", "xla_s"):
+                bv, cv = e.get(key), ce.get(key)
+                if not bv or not cv:
+                    continue
+                checked += 1
+                tol = max(TOL_CAP, min_tol)  # best-of-3, no pass record
+                if cv / bv - 1.0 > tol:
+                    reg.append(_finding("kernel-wall", f"{tag}:{key}",
+                                        float(bv), float(cv), tol,
+                                        "regression"))
 
     return {"ok": not reg, "regressions": reg, "improvements": imp,
             "checked": checked}

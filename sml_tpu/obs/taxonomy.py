@@ -45,6 +45,13 @@ COUNTERS = {
     "staging.evict_bytes", "staging.bin_evict_bytes",
     "shuffle.rows", "shuffle.bytes",
     "cv.batchFolds.fallback",
+    # fused tree kernels (native/hist_kernel.py, docs/KERNELS.md):
+    # kernel.pallas_launch / kernel.interpret are TRACE-TIME statics
+    # (counted once per program trace, like collective.*: launches per
+    # execution = the count × executions); kernel.fallback counts fits
+    # that requested pallas but degraded to the XLA path — bench_diff
+    # treats any growth as a regression
+    "kernel.*",
     "compile.programs",
     "compile.program.*",  # per-name program-cache-miss counts (bench
                           # derives distinct-programs-per-leg from these)

@@ -377,6 +377,43 @@ def test_bench_diff_counter_and_collective_and_coverage_rules(tmp_path):
                               regress.normalize(cand_raw))
         assert any(f["kind"] == "multichip-collective"
                    for f in res["regressions"])
+    # kernel.fallback growth -> EXACT rule: growth by even 1 flags, and
+    # a key ABSENT from the base leg counts as 0 (legs only record
+    # counters that fired, so the realistic regression is 0 -> N with no
+    # base key at all)
+    cand = copy.deepcopy(base)
+    assert "kernel.fallback" not in cand["legs"]["ml07_rf"]["counters"]
+    cand["legs"]["ml07_rf"]["counters"]["kernel.fallback"] = 1.0
+    res = regress.compare(base, cand)
+    assert any(f["kind"] == "leg-counter"
+               and f["key"].endswith("kernel.fallback")
+               for f in res["regressions"])
+    if raw.get("kernel"):
+        cand_raw = copy.deepcopy(raw)
+        for e in cand_raw["kernel"]["legs"]:
+            e["kernel_counters"]["kernel.fallback"] += 1.0
+        res = regress.compare(regress.normalize(raw),
+                              regress.normalize(cand_raw))
+        assert any(f["kind"] == "kernel-fallback"
+                   for f in res["regressions"])
+        # the kernelbench gate vanishing (or one sweep leg) is coverage
+        # loss, same as an ordinary leg going missing
+        cand_raw = copy.deepcopy(raw)
+        cand_raw.pop("kernel")
+        res = regress.compare(regress.normalize(raw),
+                              regress.normalize(cand_raw))
+        assert any(f["kind"] == "missing-kernel-block"
+                   for f in res["regressions"])
+        cand_raw = copy.deepcopy(raw)
+        cand_raw["kernel"]["legs"] = cand_raw["kernel"]["legs"][1:]
+        res = regress.compare(regress.normalize(raw),
+                              regress.normalize(cand_raw))
+        assert any(f["kind"] == "missing-kernel-leg"
+                   for f in res["regressions"])
+        # and the committed kernel block self-compares clean
+        res0 = regress.compare(regress.normalize(raw),
+                               regress.normalize(raw))
+        assert res0["ok"]
 
 
 def test_regress_verdicts_annotate_the_trace(recorder, tmp_path):

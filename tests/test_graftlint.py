@@ -110,6 +110,38 @@ def test_bypass_allows_dispatch_module_and_allowlist(lint):
     assert rep.clean
 
 
+def test_bypass_flags_pallas_call_outside_native(lint):
+    """A raw kernel launch outside sml_tpu/native/ is a compile + device
+    launch the kernel.* counters and fallback ladder never govern."""
+    rep = run_on(lint, {"sml_tpu/ml/rogue_kernel.py": (
+        "def fused(x):\n"
+        "    return pl.pallas_call(kern, out_shape=s)(x)\n")},
+        rules=BYPASS)
+    assert rules_fired(rep) == BYPASS
+    assert "pallas_call" in rep.violations[0].message
+    assert "sml_tpu/native/" in rep.violations[0].message
+    # the bare-name spelling (from jax.experimental.pallas import
+    # pallas_call) is the same launch
+    rep2 = run_on(lint, {"sml_tpu/serving/rogue2.py": (
+        "out = pallas_call(kern, out_shape=s)(x)\n")}, rules=BYPASS)
+    assert rules_fired(rep2) == BYPASS
+
+
+def test_bypass_allows_pallas_call_in_native_dir(lint):
+    """sml_tpu/native/ is the sanctioned kernel module (directory-prefix
+    allowlist): launches there are counted and fallback-governed. The
+    entry is FORM-scoped — it blesses pallas_call only, so a bare
+    jax.jit smuggled under native/ still flags like anywhere else."""
+    rep = run_on(lint, {"sml_tpu/native/hist_kernel.py": (
+        "def hist_accumulate(x):\n"
+        "    return pl.pallas_call(kern, out_shape=s)(x)\n")},
+        rules=BYPASS)
+    assert rep.clean
+    rep2 = run_on(lint, {"sml_tpu/native/other.py": (
+        "f = jax.jit(lambda x: x)\n")}, rules=BYPASS)
+    assert rules_fired(rep2) == BYPASS
+
+
 # --------------------------------------------------- rule 3: conf-key-registry
 CONF = ["conf-key-registry"]
 _REGISTRY = ("def _register(k, d, c, doc=''):\n    pass\n"
