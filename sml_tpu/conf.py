@@ -191,6 +191,27 @@ _register("sml.cv.trialAxisDevices", 0, int,
           "largest mesh divisor <= k. Results match the rows-only layout "
           "within float reduction-order tolerance (sampling draws are "
           "mesh-layout-invariant)")
+_register("sml.data.chunkRows", 65536, int,
+          "Row-block size of the out-of-core data plane (frame/_chunks.py): "
+          "ChunkSources yield columnar chunks of at most this many rows, "
+          "and the chunked ingest path quantizes + stages one chunk at a "
+          "time so host residency is bounded by a few chunk buffers plus "
+          "the COMPACT bin matrix, never the raw float data. See "
+          "docs/DATAPLANE.md")
+_register("sml.data.sketchBuckets", 2048, int,
+          "Centroid budget per feature for the streamed-quantization "
+          "quantile sketch: below the exact cap the sketch holds raw "
+          "values (bin edges bit-identical to the monolithic "
+          "make_bins), above it each feature compresses to this many "
+          "weight-uniform centroids (edges within one bin width for "
+          "buckets >> maxBins). Sketches merge like obs._metrics "
+          "snapshots: per-chunk summaries sum into one")
+_register("sml.data.prefetchChunks", 2, int,
+          "Chunked-ingest lookahead: chunks dispatched (H2D + device "
+          "bin-accumulate) ahead of the drain point, so chunk i+1's host "
+          "quantization overlaps chunk i's transfer and device work — "
+          "the double-buffered H2D prefetch. Also bounds the chunk_stage "
+          "HBM pool to ~this many chunk blocks. 1 = fully synchronous")
 _register("sml.tune.candidatesPerDispatch", 4, int,
           "TPE candidates proposed AND scored per generation for "
           "batch-capable fmin objectives (fn.score_batch): a "

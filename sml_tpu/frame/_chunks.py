@@ -1,0 +1,484 @@
+"""Out-of-core data plane: chunked columnar ingestion (host side).
+
+The engine's whole frame/CompactParts layer historically assumed the raw
+dataset is resident in host memory and staged to device in one shot —
+fine at the 60k-row course scale, wrong at the 10M–100M-row scale the
+ROADMAP calls for. This module is the host half of the fix:
+
+- `ChunkSource`: an ITERATOR PROTOCOL over row-block columnar chunks
+  (`sml.data.chunkRows` rows apiece). A source must be re-iterable
+  (`chunks()` returns a fresh iterator each call — the streamed
+  quantization below is a two-pass fit) and yields `(X, y)` pairs in
+  GLOBAL ROW ORDER, so downstream row-wise draws are a pure function of
+  the global row index, never of the chunk layout.
+- `FeatureSketch` / `DatasetSketch`: a MERGEABLE quantile sketch
+  (mergeable the way `obs._metrics` snapshots merge — per-chunk
+  summaries sum into one) built per chunk then unified into the bin
+  edges. Below `_EXACT_CAP` retained values the sketch is EXACT: it
+  holds the raw finite values and finalizes through the same
+  `np.quantile` call as the monolithic `tree_impl.make_bins`, so bin
+  edges are BIT-IDENTICAL on small data. Past the cap each feature
+  compresses to `sml.data.sketchBuckets` weight-uniform centroids —
+  edge error bounded by one bucket's weight, i.e. within one bin width
+  whenever sketchBuckets >> maxBins (the monolithic path is itself
+  subsampled past the same cap, so neither side is "the truth" there).
+- `chunk_random_split` / `split_assignments`: distributed
+  randomSplit/shuffle as CHUNK-LOCAL draws — membership per row comes
+  from a stateless hash of (seed, global row index)
+  (`sampling.row_uniforms`, the host mirror of the PR-6 `_sliced_draw`
+  layout-invariance scheme), so split membership is bit-identical
+  regardless of chunk size. Nested splits stay invariant too: a
+  filtered source numbers its rows by their position in the FILTERED
+  stream, which is itself chunk-layout-invariant.
+
+The device half (per-chunk H2D + device bin-accumulate under the
+double-buffered prefetch pipeline) lives in `ml/_staging.py` /
+`ml/_chunked.py`; the knob table and memory model are in
+docs/DATAPLANE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+
+#: retained finite values per feature below which the sketch is EXACT
+#: (raw values kept, edges from the same np.quantile the monolithic
+#: make_bins runs). The SAME constant make_bins uses as its
+#: deterministic-subsample threshold: below it both paths are exact and
+#: bit-identical; above it both are approximations of the same stream.
+_EXACT_CAP = 262_144
+
+
+def default_chunk_rows() -> int:
+    return max(int(GLOBAL_CONF.getInt("sml.data.chunkRows")), 1)
+
+
+# ------------------------------------------------------------- chunk sources
+class ChunkSource:
+    """Base protocol for row-block columnar sources.
+
+    Subclasses implement `_iter_chunks()` yielding `(X, y)` pairs —
+    `X` a (rows, n_features) float ndarray, `y` a (rows,) ndarray or
+    None — in global row order, bounded by `chunk_rows` rows each.
+    `n_rows` may be None until a full pass has counted it (the two-pass
+    ingest counts during the sketch pass). `fingerprint()` (optional)
+    identifies the source CONTENT cheaply so repeated fits on the same
+    source hit the ingest memo instead of re-reading.
+    """
+
+    n_features: int
+    n_rows: Optional[int] = None
+
+    @property
+    def chunk_rows(self) -> int:
+        return getattr(self, "_chunk_rows", None) or default_chunk_rows()
+
+    def chunks(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """A FRESH iterator over the chunks (re-iterable by contract)."""
+        n = 0
+        for X, y in self._iter_chunks():
+            n += int(np.shape(X)[0])
+            yield X, y
+        self.n_rows = n
+
+    def _iter_chunks(self):
+        raise NotImplementedError
+
+    def fingerprint(self) -> Optional[tuple]:
+        return None
+
+    # ------------------------------------------------------------- sampling
+    def randomSplit(self, weights: Sequence[float],
+                    seed: int) -> List["FilteredChunkSource"]:
+        return chunk_random_split(self, weights, seed)
+
+    def sample(self, fraction: float, seed: int) -> "FilteredChunkSource":
+        """Row-wise Bernoulli sample by the same stateless per-row draw
+        as randomSplit — chunk-layout-invariant membership."""
+        return FilteredChunkSource(self, 0.0, float(fraction), int(seed))
+
+
+class ArrayChunkSource(ChunkSource):
+    """A resident (X, y) pair viewed as chunks — the parity anchor: the
+    same data through `chunk_rows=None` (one chunk) and any smaller
+    chunking must produce bit-identical ingests/splits."""
+
+    def __init__(self, X: np.ndarray, y: Optional[np.ndarray] = None,
+                 chunk_rows: Optional[int] = None):
+        self._X = np.asarray(X)
+        self._y = None if y is None else np.asarray(y)
+        self._chunk_rows = int(chunk_rows) if chunk_rows else None
+        self.n_features = int(self._X.shape[1])
+        self.n_rows = int(self._X.shape[0])
+
+    @property
+    def chunk_rows(self) -> int:
+        return self._chunk_rows or self.n_rows or 1
+
+    def _iter_chunks(self):
+        c = self.chunk_rows
+        for start in range(0, self._X.shape[0], c):
+            X = self._X[start:start + c]
+            y = None if self._y is None else self._y[start:start + c]
+            yield X, y
+
+    def fingerprint(self) -> Optional[tuple]:
+        # id-based, validity pinned by the arrays themselves being held:
+        # good enough for in-process re-fit memoization; file sources
+        # fingerprint content (path, mtime, size)
+        return ("array", id(self._X), self._X.shape, str(self._X.dtype),
+                None if self._y is None else id(self._y))
+
+
+class GeneratorChunkSource(ChunkSource):
+    """Chunks produced on demand by `make(start, stop) -> (X, y)` — the
+    bench synthetic generator's shape: data is MANUFACTURED per chunk
+    (seeded by the global row range, so regeneration across the two
+    ingest passes is deterministic) and never materialized whole."""
+
+    def __init__(self, n_rows: int, n_features: int,
+                 make: Callable[[int, int], Tuple[np.ndarray, Optional[np.ndarray]]],
+                 chunk_rows: Optional[int] = None,
+                 fingerprint: Optional[tuple] = None):
+        self.n_rows = int(n_rows)
+        self.n_features = int(n_features)
+        self._make = make
+        self._chunk_rows = int(chunk_rows) if chunk_rows else None
+        self._fingerprint = fingerprint
+
+    def _iter_chunks(self):
+        c = self.chunk_rows
+        for start in range(0, self.n_rows, c):
+            yield self._make(start, min(start + c, self.n_rows))
+
+    def fingerprint(self) -> Optional[tuple]:
+        return self._fingerprint
+
+
+class FilteredChunkSource(ChunkSource):
+    """A row-wise deterministic filter view: keeps parent row i iff
+    `lo <= u(seed, i) < hi` where `u` is the stateless per-row uniform
+    (`sampling.row_uniforms`). Membership depends only on the PARENT's
+    global row index — identical for any parent chunking — and this
+    source's own rows are numbered by filtered position, so nested
+    splits are chunk-layout-invariant too."""
+
+    def __init__(self, parent: ChunkSource, lo: float, hi: float, seed: int):
+        self._parent = parent
+        self._lo = float(lo)
+        self._hi = float(hi)
+        self._seed = int(seed)
+        self.n_features = parent.n_features
+
+    @property
+    def chunk_rows(self) -> int:
+        return self._parent.chunk_rows
+
+    def _iter_chunks(self):
+        from .sampling import row_uniforms
+        start = 0
+        n_kept = 0
+        for X, y in self._parent.chunks():
+            rows = int(np.shape(X)[0])
+            u = row_uniforms(self._seed, start, rows)
+            mask = (u >= self._lo) & (u < self._hi)
+            start += rows
+            if mask.any():
+                n_kept += int(mask.sum())
+                yield (np.asarray(X)[mask],
+                       None if y is None else np.asarray(y)[mask])
+        self.n_rows = n_kept
+
+    def fingerprint(self) -> Optional[tuple]:
+        pf = self._parent.fingerprint()
+        if pf is None:
+            return None
+        return ("filter", pf, self._lo, self._hi, self._seed)
+
+
+class FoldChunkSource(ChunkSource):
+    """k-fold view for out-of-core cross validation: row i belongs to
+    fold `split_assignments(seed, i, [1]*k)[...]`; this source keeps
+    the rows IN fold `fold` (`invert=False`, the validation view) or
+    everything else (`invert=True`, the training view). Fold membership
+    is the same stateless per-row function as randomSplit — identical
+    folds for any chunking."""
+
+    def __init__(self, parent: ChunkSource, seed: int, k: int, fold: int,
+                 invert: bool = False):
+        self._parent = parent
+        self._seed = int(seed)
+        self._k = int(k)
+        self._fold = int(fold)
+        self._invert = bool(invert)
+        self.n_features = parent.n_features
+
+    @property
+    def chunk_rows(self) -> int:
+        return self._parent.chunk_rows
+
+    def _iter_chunks(self):
+        start = 0
+        n_kept = 0
+        weights = [1.0] * self._k
+        for X, y in self._parent.chunks():
+            rows = int(np.shape(X)[0])
+            cell = split_assignments(self._seed, start, rows, weights)
+            mask = (cell != self._fold) if self._invert \
+                else (cell == self._fold)
+            start += rows
+            if mask.any():
+                n_kept += int(mask.sum())
+                yield (np.asarray(X)[mask],
+                       None if y is None else np.asarray(y)[mask])
+        self.n_rows = n_kept
+
+    def fingerprint(self) -> Optional[tuple]:
+        pf = self._parent.fingerprint()
+        if pf is None:
+            return None
+        return ("fold", pf, self._seed, self._k, self._fold, self._invert)
+
+
+def chunk_random_split(source: ChunkSource, weights: Sequence[float],
+                       seed: int) -> List[FilteredChunkSource]:
+    """randomSplit over a ChunkSource as chunk-local draws: the weight
+    cells partition [0, 1) and each row lands in the cell its stateless
+    uniform falls into — splits are DISJOINT, EXHAUSTIVE, and
+    bit-identical for any chunking of the same source (asserted in
+    tests/test_chunked_ingest.py). The frame-level `randomSplit` keeps
+    its Spark draw-for-draw sampler; this is the out-of-core plane's
+    layout-invariant equivalent (one conceptual replicated key, each
+    chunk slicing its row block — the `_sliced_draw` scheme on host)."""
+    total = float(sum(weights))
+    bounds = np.cumsum([w / total for w in weights])
+    outs = []
+    lo = 0.0
+    for i, hi in enumerate(bounds):
+        # the last cell's upper bound is exactly 1.0: u < 1.0 always
+        hi = 1.0 if i == len(bounds) - 1 else float(hi)
+        outs.append(FilteredChunkSource(source, lo, hi, int(seed)))
+        lo = hi
+    return outs
+
+
+def split_assignments(seed: int, start: int, n: int,
+                      weights: Sequence[float]) -> np.ndarray:
+    """Cell index per global row [start, start+n) for the given weights
+    — the membership function `chunk_random_split` applies, exposed for
+    fold assignment (CV) and membership parity tests."""
+    from .sampling import row_uniforms
+    total = float(sum(weights))
+    bounds = np.cumsum([w / total for w in weights])
+    u = row_uniforms(int(seed), int(start), int(n))
+    return np.minimum(np.searchsorted(bounds, u, side="right"),
+                      len(bounds) - 1).astype(np.int32)
+
+
+# ------------------------------------------------------------ quantile sketch
+class FeatureSketch:
+    """Mergeable quantile summary of ONE feature's finite values.
+
+    EXACT mode (<= `exact_cap` retained values): raw values are kept and
+    `quantiles()` delegates to `np.quantile` over their concatenation —
+    bit-identical to the monolithic path. Past the cap the sketch
+    COMPRESSES to `buckets` weight-uniform centroids (value = the
+    order-statistic at each segment's weight midpoint, weight = segment
+    weight) and quantile queries interpolate over the weighted points;
+    rank error is bounded by one segment's weight (~n/buckets rows), so
+    edges land within one bin width for buckets >> maxBins. Merging two
+    sketches concatenates their (value, weight) streams and re-compresses
+    — associative up to compression, like `LogHistogram.merge`.
+    """
+
+    __slots__ = ("buckets", "exact_cap", "_vals", "_wts", "_n", "_exact",
+                 "n_seen", "compressions")
+
+    def __init__(self, buckets: Optional[int] = None,
+                 exact_cap: int = _EXACT_CAP):
+        self.buckets = int(buckets or
+                           GLOBAL_CONF.getInt("sml.data.sketchBuckets"))
+        self.exact_cap = int(exact_cap)
+        self._vals: List[np.ndarray] = []
+        self._wts: List[np.ndarray] = []
+        self._n = 0          # retained entries across the pending lists
+        self._exact = True
+        self.n_seen = 0      # total finite values observed
+        self.compressions = 0
+
+    def update(self, col: np.ndarray) -> None:
+        # dtype-preserving: exact-mode quantiles must run np.quantile on
+        # the SAME dtype stream the monolithic make_bins sees (a float32
+        # column quantiled in float64 lands on different edge bits)
+        finite = np.asarray(col)
+        finite = finite[np.isfinite(finite)]
+        if finite.size == 0:
+            return
+        self.n_seen += int(finite.size)
+        self._vals.append(finite)
+        # weights materialize lazily at compression: in exact mode (the
+        # common small-data path) a ones array per value would double
+        # the sketch's residency for nothing
+        self._wts.append(None)
+        self._n += int(finite.size)
+        if self._n > self.exact_cap:
+            self._compress()
+
+    def merge(self, other: "FeatureSketch") -> None:
+        """Fold another sketch's summary in (per-chunk sketches built in
+        parallel unify into one — the obs._metrics snapshot-merge
+        shape). Exactness survives only while the merged total fits the
+        cap."""
+        self.n_seen += other.n_seen
+        self._vals.extend(other._vals)
+        self._wts.extend(other._wts)
+        self._n += other._n
+        self._exact = self._exact and other._exact
+        if self._n > self.exact_cap:
+            self._compress()
+
+    def _compress(self) -> None:
+        """Collapse the pending stream to `buckets` weight-uniform
+        centroids: sort, then keep the order-statistic at each of
+        `buckets` equal-weight segments' midpoints."""
+        vals = np.concatenate(self._vals)
+        wts = np.concatenate([np.ones(v.size, dtype=np.float64)
+                              if w is None else w
+                              for v, w in zip(self._vals, self._wts)])
+        order = np.argsort(vals, kind="stable")
+        v, w = vals[order], wts[order]
+        if v.size > self.buckets:
+            cw = np.cumsum(w)
+            total = cw[-1]
+            # segment midpoints in weight space; min/max always retained
+            mids = (np.arange(self.buckets, dtype=np.float64) + 0.5) \
+                * (total / self.buckets)
+            idx = np.searchsorted(cw, mids, side="left")
+            idx = np.unique(np.clip(idx, 0, v.size - 1))
+            idx[0] = 0
+            idx[-1] = v.size - 1
+            # retained point i carries the weight since the previous
+            # retained point; the last point sits at the stream end, so
+            # total weight is preserved exactly
+            keep_w = np.diff(np.concatenate(([0.0], cw[idx])))
+            v, w = v[idx], keep_w
+            self._exact = False
+            self.compressions += 1
+        self._vals = [v]
+        self._wts = [w]
+        self._n = int(v.size)
+
+    @property
+    def exact(self) -> bool:
+        return self._exact
+
+    def quantiles(self, qs: np.ndarray) -> np.ndarray:
+        """Quantile values at probabilities `qs`. Exact mode calls
+        np.quantile on the raw values (bit parity with make_bins);
+        compressed mode interpolates the weighted order statistics with
+        the same (N-1)*q linear-rank convention."""
+        if self._n == 0:
+            return np.zeros(0, dtype=np.float64)
+        if self._exact:
+            return np.quantile(np.concatenate(self._vals), qs)
+        v = np.asarray(self._vals[0], dtype=np.float64)
+        w = self._wts[0]
+        cw = np.cumsum(w)
+        total = cw[-1]
+        # expanded-rank positions: point i spans ranks [cw[i-1], cw[i])
+        h = np.asarray(qs, dtype=np.float64) * (total - 1.0)
+        lo = np.searchsorted(cw, np.floor(h), side="right")
+        hi = np.searchsorted(cw, np.ceil(h), side="right")
+        lo = np.clip(lo, 0, v.size - 1)
+        hi = np.clip(hi, 0, v.size - 1)
+        frac = h - np.floor(h)
+        return v[lo] + (v[hi] - v[lo]) * frac
+
+
+class DatasetSketch:
+    """Per-feature sketches + streamed categorical label stats — one
+    object per ingest pass 1, updated chunk by chunk, finalized into a
+    `tree_impl.Binning` via `tree_impl.finalize_binning` (the SAME
+    assembly the monolithic make_bins now runs, so the two paths cannot
+    drift)."""
+
+    def __init__(self, n_features: int,
+                 categorical: Optional[Dict[int, int]] = None,
+                 buckets: Optional[int] = None,
+                 exact_cap: int = _EXACT_CAP):
+        self.n_features = int(n_features)
+        self.categorical = dict(categorical or {})
+        self.features = {f: FeatureSketch(buckets, exact_cap)
+                         for f in range(n_features)
+                         if f not in self.categorical}
+        # categorical slot -> (sum_y, count) per category id, streamed
+        self._cat_sum = {f: np.zeros(int(card), dtype=np.float64)
+                         for f, card in self.categorical.items()}
+        self._cat_cnt = {f: np.zeros(int(card), dtype=np.int64)
+                         for f in self.categorical}
+        self.n_rows = 0
+
+    def update(self, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
+        X = np.asarray(X)
+        self.n_rows += int(X.shape[0])
+        for f, sk in self.features.items():
+            sk.update(X[:, f])
+        if self.categorical and y is not None:
+            # round labels through float32 FIRST: the monolithic path
+            # computes category means from the float32 y32, and a raw
+            # float64 accumulation here could order two near-tied
+            # categories differently than make_bins
+            y = np.asarray(y, dtype=np.float32).astype(np.float64)
+        for f in self.categorical:
+            card = int(self.categorical[f])
+            ids = np.clip(X[:, f].astype(np.int64), 0, card - 1)
+            if y is not None:
+                self._cat_sum[f] += np.bincount(ids, weights=y,
+                                                minlength=card)
+            self._cat_cnt[f] += np.bincount(ids, minlength=card)
+
+    def merge(self, other: "DatasetSketch") -> None:
+        self.n_rows += other.n_rows
+        for f, sk in self.features.items():
+            sk.merge(other.features[f])
+        for f in self.categorical:
+            self._cat_sum[f] += other._cat_sum[f]
+            self._cat_cnt[f] += other._cat_cnt[f]
+
+    @property
+    def exact(self) -> bool:
+        return all(sk.exact for sk in self.features.values())
+
+    def cat_means(self, with_labels: bool) -> Dict[int, np.ndarray]:
+        """Per-category mean label (inf for absent categories) — the
+        label-mean category ordering make_bins applies. Streamed sums
+        accumulate in float64; pathological ties between categories with
+        numerically-equal means may order differently than the
+        monolithic pairwise-summed np.mean (documented deviation)."""
+        out = {}
+        for f in self.categorical:
+            card = int(self.categorical[f])
+            means = np.full(card, np.inf)
+            seen = self._cat_cnt[f] > 0
+            if with_labels:
+                means[seen] = self._cat_sum[f][seen] / self._cat_cnt[f][seen]
+            else:
+                means[seen] = np.nonzero(seen)[0].astype(np.float64)
+            out[f] = means
+        return out
+
+    def to_binning(self, max_bins: int, with_labels: bool = True,
+                   max_categories_error: bool = True):
+        """Finalize into (Binning, edge_list, out_dtype) through
+        `tree_impl.finalize_binning` — one assembly for both paths."""
+        from ..ml.tree_impl import finalize_binning
+        probs = np.linspace(0, 1, max_bins + 1)[1:-1]
+        cont_q = {f: sk.quantiles(probs) if sk.n_seen else None
+                  for f, sk in self.features.items()}
+        return finalize_binning(self.n_features, max_bins, self.categorical,
+                                cont_q, self.cat_means(with_labels),
+                                max_categories_error=max_categories_error)

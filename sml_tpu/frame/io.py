@@ -253,6 +253,61 @@ class DataFrameWriter:
         session.catalog._register_table(name, path, self._format)
 
 
+# ------------------------------------------------------ out-of-core chunks
+from ._chunks import ChunkSource as _ChunkSource
+
+
+class ParquetChunkSource(_ChunkSource):
+    """`_chunks.ChunkSource` over parquet part-files WITHOUT whole-file
+    materialization: `pyarrow.ParquetFile.iter_batches` streams row
+    blocks of `chunk_rows`, each assembled into a (rows, F) float matrix
+    + optional label column — the on-disk entry to the out-of-core data
+    plane (docs/DATAPLANE.md). Files iterate in the same sorted order
+    `DataFrameReader.parquet` reads them, so global row order (and with
+    it chunk-local split membership) matches the materialized frame's
+    row order."""
+
+    def __init__(self, path: str, feature_cols: List[str],
+                 label_col: Optional[str] = None,
+                 chunk_rows: Optional[int] = None):
+        self._files = _expand(path, (".parquet",))
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self._chunk_rows = int(chunk_rows) if chunk_rows else None
+        self.n_features = len(self.feature_cols)
+        self.n_rows: Optional[int] = None
+
+    def _iter_chunks(self):
+        cols = self.feature_cols + ([self.label_col] if self.label_col
+                                    else [])
+        for f in self._files:
+            pf = pq.ParquetFile(f)
+            for batch in pf.iter_batches(batch_size=self.chunk_rows,
+                                         columns=cols):
+                pdf = batch.to_pandas()
+                X = np.column_stack([
+                    np.asarray(pdf[c], dtype=np.float64)
+                    for c in self.feature_cols])
+                y = (np.asarray(pdf[self.label_col], dtype=np.float64)
+                     if self.label_col else None)
+                yield X, y
+
+    def fingerprint(self):
+        sig = tuple((f, os.path.getmtime(f), os.path.getsize(f))
+                    for f in self._files)
+        return ("parquet", sig, tuple(self.feature_cols), self.label_col,
+                self.chunk_rows)
+
+
+def read_parquet_chunks(path: str, featureCols: List[str],
+                        labelCol: Optional[str] = None,
+                        chunkRows: Optional[int] = None) -> ParquetChunkSource:
+    """Open a parquet file/directory/glob as a ChunkSource for the
+    out-of-core data plane: `sml_tpu.ml._chunked.fit_ensemble_chunked`
+    and friends consume it without the dataset ever being resident."""
+    return ParquetChunkSource(path, featureCols, labelCol, chunkRows)
+
+
 def _expand(path: str, exts) -> List[str]:
     """Path may be a file, a directory of part-files, or a glob."""
     if os.path.isfile(path):

@@ -147,6 +147,40 @@ def partition_uniforms(seed: int, partition_index: int, n: int) -> np.ndarray:
     return out
 
 
+# ----------------------------------------------------- stateless per-row draws
+# The out-of-core data plane (frame/_chunks.py) decides split/sample
+# membership per GLOBAL ROW INDEX, not per partition stream: a stateless
+# counter-based hash of (seed, row) is random-access, so any chunk can
+# compute its own rows' draws without replaying a sequential stream —
+# the host mirror of the PR-6 `tree_impl._sliced_draw` layout-invariance
+# scheme (one replicated key, each shard slicing its block). Split
+# membership is therefore bit-identical for ANY chunking of the same
+# rows (tests/test_chunked_ingest.py pins it).
+
+_U64 = np.uint64
+_SPLITMIX_GAMMA = 0x9E3779B97F4A7C15  # splitmix64's golden-gamma increment
+
+
+def row_uniforms(seed: int, start: int, n: int) -> np.ndarray:
+    """Uniform [0, 1) draw per global row index in [start, start+n):
+    splitmix64 finalizer over a (seed, index) counter — vectorized, no
+    sequential state, identical per row regardless of the chunk layout
+    that asked. (This is deliberately NOT the Spark-parity sampler: the
+    XORShift stream is sequential per partition; the chunked plane needs
+    random access.)"""
+    if n == 0:
+        return np.empty(0, dtype=np.float64)
+    idx = np.arange(start, start + n, dtype=np.uint64)
+    # mix the seed into the counter stream, then splitmix64-finalize
+    z = (_U64((int(seed) * 0xD1B54A32D192ED03) & 0xFFFFFFFFFFFFFFFF)
+         + (idx + _U64(1)) * _U64(_SPLITMIX_GAMMA))
+    z = (z ^ (z >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> _U64(27))) * _U64(0x94D049BB133111EB)
+    z = z ^ (z >> _U64(31))
+    # top 53 bits -> double in [0, 1), the java/Random two-word convention
+    return (z >> _U64(11)).astype(np.float64) * (2.0 ** -53)
+
+
 # ------------------------------------------------------- pre-split local sort
 # id(source pdf) -> (source, sorted, cost_bytes). BYTE-bounded like the
 # repo's other memos (sml.split.sortMemoBytes): each entry strong-refs a

@@ -53,6 +53,10 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
         "data_parallel": "THE blessed jit+shard_map compile helper; every "
                          "cached build is reported via obs.note_compile in "
                          "cached_data_parallel",
+        "_chunk_assemble_program": "chunked-ingest bin-assembly program "
+                                   "(donated dynamic_update_slice); built "
+                                   "once and reported via obs.note_compile"
+                                   "('chunk_assemble')",
     },
     "sml_tpu/ml/tree_impl.py": {
         "_compiled_chunk": "chunked-boosting program cache; each build is "

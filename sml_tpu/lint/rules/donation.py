@@ -31,7 +31,12 @@ from ..project import Project
 #: `_compiled_chunk` donates the margin carry (arg 3) on real devices —
 #: see tree_impl._compiled_chunk; keep in sync when adding donating
 #: program caches.
-KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {"_compiled_chunk": (3,)}
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {
+    "_compiled_chunk": (3,),
+    # the chunked-ingest assembly program donates the bin-matrix buffer
+    # (arg 0) — the legal idiom is `buf = prog(buf, block, start)`
+    "_chunk_assemble_program": (0,),
+}
 
 
 def _donate_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:

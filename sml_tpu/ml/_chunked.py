@@ -1,0 +1,306 @@
+"""Out-of-core chunked ingest + fit/CV/predict (the device half).
+
+`frame/_chunks.py` defines the host protocol (ChunkSource, the mergeable
+quantile sketch, chunk-local split draws); this module runs it against
+the engine:
+
+- `ingest_source`: the TWO-PASS streamed quantization. Pass 1 streams
+  chunks through per-chunk `DatasetSketch`es merged into one (counting
+  rows as it goes); the unified sketch finalizes into the bin edges.
+  Pass 2 re-streams the source through the shared
+  `parallel.pipeline.prefetch_pipeline`: chunk i+1's host quantization
+  (`_bin_columns` on worker threads) overlaps chunk i's H2D transfer +
+  device bin-accumulate (`_staging._chunk_assemble_program`, a donated
+  dynamic_update_slice into the padded device matrix), with
+  `ingest.dispatch`/`ingest.drain` events proving the overlap and a
+  stall-watchdog ticket per in-flight chunk. The assembled device matrix
+  is adopted into the bin cache (`insert_bins_cached`), so HBM holds the
+  COMPACT representation plus ~`sml.data.prefetchChunks` transient chunk
+  blocks (ledger pool `chunk_stage`) — never the raw float data.
+- `fit_ensemble_chunked`: `_tree_models._fit_ensemble` fed through
+  `prebinned=` — everything downstream of quantization is the SAME code
+  path as the monolithic fit (bit-parity by construction when the
+  sketch is exact).
+- `cross_validate_chunked` / `predict_chunked`: k-fold CV over
+  `FoldChunkSource` views and streamed prediction, so fit + CV + predict
+  all run end-to-end from a ChunkSource.
+
+Per-chunk prep walls feed `obs.INGEST_SKEW` (the `SKEW.note`-style
+BSP attribution with chunk indices as lanes), so a slow ingest chunk is
+NAMED in `engine_health()["ingest"]` instead of averaged away.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+from ..frame._chunks import ChunkSource, DatasetSketch, FoldChunkSource
+from ..parallel import mesh as meshlib
+from ..utils.profiler import PROFILER, now
+from .tree_impl import Binning, _bin_columns
+
+
+class IngestResult(NamedTuple):
+    binned: np.ndarray          # (n, F) compact host mirror (view of the
+                                # padded assembly buffer — the bin-cache key)
+    y: Optional[np.ndarray]     # (n,) float32 labels (None = unlabeled)
+    binning: Binning
+    n_rows: int
+    n_padded: int
+    stats: dict                 # per-ingest attribution (see docs/DATAPLANE.md)
+
+
+#: fingerprint-keyed memo of completed ingests: a re-fit on the SAME
+#: source (CV over fold views shares the parent's chunks, repeated
+#: bench fits) skips both passes. Two entries: each pins one compact
+#:  matrix (~n bytes) — the realistic reuse window is "the dataset I am
+#: working on" plus one fold view.
+_ingest_memo: dict = {}
+_INGEST_MEMO_ENTRIES = 2
+
+
+def _memo_key(source: ChunkSource, max_bins: int,
+              categorical: Optional[Dict[int, int]]) -> Optional[tuple]:
+    fp = source.fingerprint()
+    if fp is None:
+        return None
+    return (fp, int(max_bins), tuple(sorted((categorical or {}).items())),
+            int(source.chunk_rows))
+
+
+def sketch_source(source: ChunkSource, max_bins: int,
+                  categorical: Optional[Dict[int, int]] = None
+                  ) -> DatasetSketch:
+    """Ingest pass 1: one `DatasetSketch` PER CHUNK, merged into the
+    unified sketch (the mergeable contract — per-chunk summaries built
+    independently then unified, exactly how a multi-process ingest would
+    combine them)."""
+    unified = DatasetSketch(source.n_features, categorical)
+    for X, y in source.chunks():
+        chunk_sk = DatasetSketch(source.n_features, categorical)
+        chunk_sk.update(X, y)
+        unified.merge(chunk_sk)
+    return unified
+
+
+def ingest_source(source: ChunkSource, max_bins: int,
+                  categorical: Optional[Dict[int, int]] = None,
+                  label: str = "source") -> IngestResult:
+    """Two-pass streamed quantization of a ChunkSource into the engine's
+    compact bin representation (module docstring has the pipeline
+    shape). Returns the host mirror + binning with the assembled device
+    copy already adopted into the bin cache."""
+    key = _memo_key(source, max_bins, categorical)
+    hit = _ingest_memo.get(key) if key is not None else None
+    if hit is not None:
+        PROFILER.count("ingest.memo_hit")
+        return hit
+
+    # ---- pass 1: streamed sketch (counts rows, learns edges)
+    t0 = now()
+    sketch = sketch_source(source, max_bins, categorical)
+    binning, edge_list, out_dtype = sketch.to_binning(max_bins)
+    n = sketch.n_rows
+    sketch_s = now() - t0
+    PROFILER.count("ingest.sketch_compress", float(sum(
+        sk.compressions for sk in sketch.features.values())))
+
+    # ---- pass 2: quantize + double-buffered device assembly
+    import jax
+    from ..obs import INGEST_SKEW, LEDGER
+    from ..parallel.pipeline import prefetch_pipeline
+    from ._staging import (_chunk_assemble_program, insert_bins_cached,
+                           transient_hbm)
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_padded = meshlib.bucket_rows(n, n_dev)
+    F = source.n_features
+    C = min(max(int(source.chunk_rows), 1), n_padded)
+    host = np.zeros((n_padded, F), dtype=out_dtype)
+    # labels allocated up front (padded, zeros) so concurrent preps never
+    # race an allocation; whether ANY chunk carried labels is resolved at
+    # dispatch (serial)
+    y_host = np.zeros(n_padded, dtype=np.float32)
+    labeled = [False]
+    buf = None  # created lazily so an empty source never stages
+    prog = _chunk_assemble_program()
+    prep_walls: list = []   # appended at DISPATCH (serial) -> chunk order
+    dispatch_walls: list = []
+    raw_bytes = [0]
+    depth = max(GLOBAL_CONF.getInt("sml.data.prefetchChunks"), 1)
+
+    def offsets():
+        start = 0
+        for X, y in source.chunks():
+            rows = int(np.shape(X)[0])
+            yield start, X, y
+            start += rows
+
+    def prep(item):
+        """Host quantization of one chunk (worker threads; the numpy/
+        native-binning C paths release the GIL) + write into the compact
+        host mirror. Chunks prep at most `workers` ahead, so host
+        residency is the mirror plus a few RAW chunk buffers — never the
+        raw dataset. Writes are disjoint row ranges; shared counters are
+        returned, not mutated (dispatch is the serial side)."""
+        t1 = now()
+        start, X, y = item
+        X = np.asarray(X)
+        rows = X.shape[0]
+        nbytes = X.nbytes + (0 if y is None else np.asarray(y).nbytes)
+        block = _bin_columns(X, edge_list, binning.cat_remap, out_dtype)
+        host[start:start + rows] = block
+        if y is not None:
+            y_host[start:start + rows] = np.asarray(y, dtype=np.float32)
+        return start, rows, y is not None, nbytes, now() - t1
+
+    def dispatch(_i, prepped):
+        """Serial, in submission order: H2D the chunk's device block and
+        fold it into the resident matrix. The block is sliced from the
+        host mirror over a FIXED C-row window (clamped at the buffer
+        end), so every chunk — including ragged filtered chunks — rides
+        ONE executable; rows a window covers beyond its own chunk are
+        rewritten correctly by later (strictly ordered) dispatches."""
+        nonlocal buf
+        start, rows, has_y, nbytes, prep_wall = prepped
+        t1 = now()
+        labeled[0] = labeled[0] or has_y
+        raw_bytes[0] += nbytes
+        prep_walls.append(prep_wall)
+        if buf is None:
+            buf = jax.device_put(np.zeros((n_padded, F), dtype=out_dtype),
+                                 meshlib.data_sharding(mesh, 2))
+        start_d = min(start, n_padded - C)
+        block = np.ascontiguousarray(host[start_d:start_d + C])
+        # replicated across the mesh (no divisibility constraint on C):
+        # the transient chunk_stage pool charges the PER-DEVICE copies
+        block_dev = jax.device_put(block, meshlib.replicated(mesh))
+        hold = transient_hbm("chunk_stage", block.nbytes * n_dev)
+        hold.__enter__()
+        PROFILER.count("ingest.h2d_bytes", float(block.nbytes))
+        buf = prog(buf, block_dev, np.int32(start_d))
+        dispatch_walls.append(now() - t1)
+        return hold
+
+    def drain(_i, hold):
+        hold.__exit__(None, None, None)
+        return None
+
+    t2 = now()
+    for _ in prefetch_pipeline(offsets(), prep, dispatch, drain,
+                               depth=depth, workers=min(depth + 1, 4),
+                               family="ingest", index_key="chunk"):
+        pass
+    pipeline_s = now() - t2
+
+    binned = host[:n]
+    y_out = y_host[:n] if labeled[0] else None
+    if buf is not None:
+        insert_bins_cached(binned, buf)
+    n_chunks = len(prep_walls)
+    PROFILER.count("ingest.chunks", float(n_chunks))
+    PROFILER.count("ingest.rows", float(n))
+    PROFILER.count("ingest.raw_bytes", float(raw_bytes[0]))
+    if n_chunks:
+        INGEST_SKEW.note(f"ingest.{label}", prep_walls,
+                         devices=list(range(n_chunks)), wall_s=pipeline_s)
+    stats = {
+        "n_chunks": n_chunks,
+        "chunk_rows": C,
+        "prefetch_depth": depth,
+        "sketch_exact": sketch.exact,
+        "sketch_s": round(sketch_s, 4),
+        "pipeline_s": round(pipeline_s, 4),
+        "prep_s": round(float(sum(prep_walls)), 4),
+        "dispatch_s": round(float(sum(dispatch_walls)), 4),
+        "raw_bytes": int(raw_bytes[0]),
+        "compact_bytes": int(host.nbytes),
+        "chunk_stage_peak_bytes": int(
+            LEDGER.snapshot().get("chunk_stage", {}).get("peak", 0)),
+    }
+    out = IngestResult(binned=binned, y=y_out, binning=binning,
+                       n_rows=n, n_padded=n_padded, stats=stats)
+    if key is not None:
+        while len(_ingest_memo) >= _INGEST_MEMO_ENTRIES:
+            _ingest_memo.pop(next(iter(_ingest_memo)))
+        _ingest_memo[key] = out
+    return out
+
+
+def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
+                         max_depth: int, max_bins: int,
+                         min_instances: int = 1,
+                         min_info_gain: float = 0.0, n_trees: int = 1,
+                         feature_k: Optional[int] = None,
+                         bootstrap: bool = False, subsample: float = 1.0,
+                         seed: int = 17, loss: str = "squared",
+                         step_size: float = 0.1, reg_lambda: float = 0.0,
+                         gamma: float = 0.0, boosting: bool = False,
+                         rounds_per_dispatch: Optional[int] = None):
+    """Tree-ensemble fit end-to-end from a ChunkSource: streamed
+    quantization, then the ordinary `_fit_ensemble` over the prebinned
+    compact matrix — the raw float data is never resident whole on host
+    or device."""
+    from ._tree_models import _fit_ensemble
+    ing = ingest_source(source, max_bins, categorical, label="fit")
+    if ing.y is None:
+        raise ValueError("fit_ensemble_chunked needs a labeled ChunkSource "
+                         "(chunks must yield (X, y) with y not None)")
+    return _fit_ensemble(
+        None, ing.y, categorical=categorical or {}, max_depth=max_depth,
+        max_bins=max_bins, min_instances=min_instances,
+        min_info_gain=min_info_gain, n_trees=n_trees, feature_k=feature_k,
+        bootstrap=bootstrap, subsample=subsample, seed=seed, loss=loss,
+        step_size=step_size, reg_lambda=reg_lambda, gamma=gamma,
+        boosting=boosting, rounds_per_dispatch=rounds_per_dispatch,
+        prebinned=(ing.binned, ing.binning))
+
+
+def iter_predictions(spec, source: ChunkSource):
+    """Streamed prediction: one (chunk_predictions, chunk_labels) pair
+    per chunk through `_EnsembleSpec.predict_margin` — each chunk bins
+    and stages alone, so predict-side residency is chunk-bounded too.
+    Per-row traversal is batch-size-invariant, so chunked predictions
+    are bit-identical to the monolithic call."""
+    for X, y in source.chunks():
+        yield spec.predict_margin(np.asarray(X, dtype=np.float64)), y
+
+
+def predict_chunked(spec_or_model, source: ChunkSource) -> np.ndarray:
+    """Concatenated predictions for a whole ChunkSource (the (n,) output
+    is float64 — 8 bytes/row, bounded even at 100M rows)."""
+    spec = getattr(spec_or_model, "_spec", spec_or_model)
+    outs = [p for p, _ in iter_predictions(spec, source)]
+    return np.concatenate(outs) if outs else np.zeros(0)
+
+
+def cross_validate_chunked(source: ChunkSource, k: int, split_seed: int, *,
+                           categorical=None, **fit_params) -> dict:
+    """k-fold CV from a ChunkSource: fold membership is the chunk-local
+    stateless draw (`FoldChunkSource`), each fold's training view fits
+    through the chunked path and evaluates streaming RMSE on the held
+    fold — no fold dataset is ever materialized whole. `split_seed`
+    seeds the fold draw; the estimator's own `seed` rides `fit_params`.
+
+    Fold FITS are bit-identical to any other chunking of the same source
+    (fold membership and quantization both are); the streamed RMSE
+    accumulates per chunk, so the metric matches other chunkings within
+    float reduction-order tolerance (~1 ulp), not bit-for-bit."""
+    fold_rmse = []
+    for j in range(int(k)):
+        train = FoldChunkSource(source, split_seed, k, j, invert=True)
+        val = FoldChunkSource(source, split_seed, k, j, invert=False)
+        spec = fit_ensemble_chunked(train, categorical=categorical,
+                                    **fit_params)
+        sse = 0.0
+        cnt = 0
+        for pred, y in iter_predictions(spec, val):
+            d = pred - np.asarray(y, dtype=np.float64)
+            sse += float(d @ d)
+            cnt += d.size
+        fold_rmse.append(float(np.sqrt(sse / max(cnt, 1))))
+    return {"avg_rmse": float(np.mean(fold_rmse)), "fold_rmse": fold_rmse,
+            "k": int(k), "seed": int(split_seed)}

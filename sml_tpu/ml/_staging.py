@@ -229,30 +229,28 @@ def _bin_cache_budget() -> int:
     return GLOBAL_CONF.getInt("sml.tree.binCacheBytes")
 
 
-def stage_bins_cached(binned: np.ndarray) -> jax.Array:
-    """device_put a quantized bin-index matrix through the bin cache.
+def _bin_cache_key(a: np.ndarray, mesh) -> tuple:
+    return (_memo_key(a), id(mesh), "bins",
+            mesh.shape[meshlib.DATA_AXIS])
 
-    Rows are bucket-padded exactly like `stage_rows_cached`, so aligned
-    per-row arrays (labels, masks) staged through the general cache land
-    on the same padded shape."""
-    from ..utils.profiler import PROFILER
-    mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
-    a = _normalize(binned)
-    key = (_memo_key(a), id(mesh), "bins", n_dev)
+
+def _bin_cache_touch(key):
+    """LRU probe: returns the cached device array (touched to the end of
+    eviction order) or None."""
     with _stage_lock:
         hit = _bin_stage_cache.get(key)
         if hit is not None:
             # move-to-end LRU touch (dicts iterate in insertion order)
             _bin_stage_cache.pop(key)
             _bin_stage_cache[key] = hit
-    if hit is not None:
-        PROFILER.count("staging.bin_cache_hit")
-        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
-        return hit
-    padded = meshlib.pad_rows(a, meshlib.bucket_rows(a.shape[0], n_dev))[0]
-    hit = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    return hit
+
+
+def _bin_cache_store(key, hit) -> None:
+    """Insert + LRU/ledger accounting shared by `stage_bins_cached` and
+    the chunked-ingest assembly (`insert_bins_cached`)."""
     from ..obs import LEDGER, RECORDER
+    from ..utils.profiler import PROFILER
     stored = evicted = 0
     with _stage_lock:
         if key not in _bin_stage_cache:
@@ -273,9 +271,56 @@ def stage_bins_cached(binned: np.ndarray) -> jax.Array:
         if RECORDER.enabled:
             RECORDER.emit("cache", "cache.evict",
                           args={"pool": "bin_cache", "bytes": evicted})
+
+
+def stage_bins_cached(binned: np.ndarray) -> jax.Array:
+    """device_put a quantized bin-index matrix through the bin cache.
+
+    Rows are bucket-padded exactly like `stage_rows_cached`, so aligned
+    per-row arrays (labels, masks) staged through the general cache land
+    on the same padded shape."""
+    from ..utils.profiler import PROFILER
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    a = _normalize(binned)
+    key = _bin_cache_key(a, mesh)
+    hit = _bin_cache_touch(key)
+    if hit is not None:
+        PROFILER.count("staging.bin_cache_hit")
+        PROFILER.count("staging.h2d_bytes_saved", a.nbytes)
+        return hit
+    padded = meshlib.pad_rows(a, meshlib.bucket_rows(a.shape[0], n_dev))[0]
+    hit = jax.device_put(padded, meshlib.data_sharding(mesh, padded.ndim))
+    _bin_cache_store(key, hit)
     PROFILER.count("staging.bin_cache_miss")
     PROFILER.count("staging.h2d_bytes", padded.nbytes)
     return hit
+
+
+def bin_cache_probe(binned: np.ndarray) -> Optional[jax.Array]:
+    """Cache probe WITHOUT staging on miss (the chunked ingest asks
+    before paying a second pass over the source)."""
+    mesh = meshlib.get_mesh()
+    a = _normalize(binned)
+    return _bin_cache_touch(_bin_cache_key(a, mesh))
+
+
+def insert_bins_cached(binned_host: np.ndarray, dev: jax.Array) -> jax.Array:
+    """Adopt an EXTERNALLY ASSEMBLED device bin matrix (the chunked
+    ingest's per-chunk device-side assembly) into the bin cache under
+    the standard content key of its host mirror, so every later fit,
+    predict, and eval on the same rows hits the assembled copy exactly
+    as if `stage_bins_cached` had staged it in one shot. The array is
+    resharded to the canonical data sharding if assembly left it
+    elsewhere (device-to-device, never back through the host)."""
+    mesh = meshlib.get_mesh()
+    a = _normalize(binned_host)
+    expect = meshlib.data_sharding(mesh, dev.ndim)
+    if getattr(dev, "sharding", None) != expect:
+        dev = jax.device_put(dev, expect)
+    key = _bin_cache_key(a, mesh)
+    _bin_cache_store(key, dev)
+    return _bin_cache_touch(key)
 
 
 def bin_cache_stats() -> dict:
@@ -283,6 +328,41 @@ def bin_cache_stats() -> dict:
     with _stage_lock:
         return {"entries": len(_bin_stage_cache),
                 "bytes": _bin_stage_bytes[0]}
+
+
+# ----------------------------------------------------- chunked bin assembly
+# The out-of-core ingest path (ml/_chunked.py) builds the device-resident
+# compact matrix CHUNK BY CHUNK: each quantized block H2Ds into a small
+# transient buffer (ledger pool `chunk_stage`) and a donated
+# dynamic_update_slice program folds it into the padded bin matrix — the
+# "bin accumulate" device work the prefetch pipeline overlaps with the
+# next chunk's host quantization. HBM therefore holds the COMPACT matrix
+# plus ~prefetchChunks chunk blocks, never the raw float data.
+_chunk_assemble_prog: list = []
+
+
+def _chunk_assemble_step(buf, block, start):
+    """Rows [start, start+block_rows) of `buf` become `block`. `buf` is
+    DONATED (arg 0): on real devices the update is in place, so assembly
+    never holds two copies of the matrix in HBM (XLA:CPU ignores
+    donation and copies — correct, just unamortized, like every other
+    donation site on the test mesh)."""
+    return jax.lax.dynamic_update_slice(buf, block, (start, 0))
+
+
+def _chunk_assemble_program():
+    """The one compiled assembly program. jit specializes per
+    (buf, block) shape/dtype/sharding internally; the chunk OFFSET rides
+    as a traced scalar, so every chunk of an ingest shares one
+    executable (note_compile records the program once — per-shape
+    re-specializations are jit-internal, like the other program
+    caches)."""
+    if not _chunk_assemble_prog:
+        from ..obs import note_compile
+        note_compile("chunk_assemble")
+        _chunk_assemble_prog.append(
+            jax.jit(_chunk_assemble_step, donate_argnums=(0,)))
+    return _chunk_assemble_prog[0]
 
 
 @contextlib.contextmanager

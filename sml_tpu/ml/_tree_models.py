@@ -214,24 +214,37 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                   step_size: float = 0.1, reg_lambda: float = 0.0,
                   gamma: float = 0.0, boosting: bool = False,
                   missing: Optional[float] = None,
-                  rounds_per_dispatch: Optional[int] = None) -> _EnsembleSpec:
+                  rounds_per_dispatch: Optional[int] = None,
+                  prebinned=None) -> _EnsembleSpec:
     """The one training path behind every tree learner: bin on host, then
     the WHOLE forest/boosting fit runs as a single on-device program
-    (`tree_impl.fit_ensemble_on_device`)."""
-    if missing is not None and not np.isnan(missing):
-        X = X.copy()
-        X[X == missing] = np.nan
-    F = X.shape[1]
-    # bin on host FIRST so the dispatcher can probe the staging cache with
-    # the actual device operand; histogram builds dominate the program:
-    # trees x levels x (n x F x bins) one-hot accumulations
+    (`tree_impl.fit_ensemble_on_device`).
+
+    `prebinned=(binned, binning)` is the out-of-core entry
+    (`ml/_chunked.py`): the compact matrix was quantized CHUNK BY CHUNK
+    and (on the device route) its assembled device copy already sits in
+    the bin cache, so X may be None — the raw float data never existed
+    whole. Everything downstream is the SAME code path as the monolithic
+    fit, which makes chunked-vs-monolithic bit-parity a structural
+    property rather than a numerical accident."""
     from ._staging import routed_for
     y32 = np.asarray(y, np.float32)
-    binned, binning = _cached_bins(X, y32, max_bins, categorical)
+    if prebinned is not None:
+        binned, binning = prebinned
+        F = binned.shape[1]
+    else:
+        if missing is not None and not np.isnan(missing):
+            X = X.copy()
+            X[X == missing] = np.nan
+        F = X.shape[1]
+        # bin on host FIRST so the dispatcher can probe the staging cache
+        # with the actual device operand; histogram builds dominate the
+        # program: trees x levels x (n x F x bins) one-hot accumulations
+        binned, binning = _cached_bins(X, y32, max_bins, categorical)
     # measured host-mesh rate for this program is ~1.2e9 ops/s (one-hot
     # expansion defeats CPU BLAS) — scatter-class, not blas
     hint = dispatch.WorkHint(
-        flops=2.0 * n_trees * max_depth * X.shape[0] * F * max_bins,
+        flops=2.0 * n_trees * max_depth * binned.shape[0] * F * max_bins,
         kind="scatter")
     with routed_for(hint, binned):
         staged = stage_tree_data(X, y32, max_bins, categorical,
@@ -572,6 +585,47 @@ class _TreeEstimatorBase(Estimator, _TreeParams):
         s = self.getOrDefault("seed")
         return int(s) if s is not None else 17
 
+    def fit_chunked(self, source):
+        """Out-of-core fit: the same estimator params applied to a
+        `frame._chunks.ChunkSource` through the streamed-quantization
+        ingest (`ml/_chunked.py`) — the raw dataset is never resident
+        whole. Returns the same model class `.fit` would (DT/RF/GBT,
+        regressor/classifier); an exact-mode sketch makes the model
+        bit-identical to fitting the materialized frame."""
+        from ._chunked import fit_ensemble_chunked
+        kwargs = dict(
+            categorical={},
+            max_depth=int(self.getOrDefault("maxDepth")),
+            max_bins=int(self.getOrDefault("maxBins")),
+            min_instances=int(self.getOrDefault("minInstancesPerNode")),
+            min_info_gain=float(self.getOrDefault("minInfoGain")),
+            seed=self._seed(),
+            loss="logistic" if self._is_classifier else "squared")
+        if self.hasParam("maxIter"):        # boosted (GBT) shape
+            kwargs.update(
+                n_trees=int(self.getOrDefault("maxIter")), feature_k=None,
+                bootstrap=False,
+                subsample=float(self.getOrDefault("subsamplingRate")),
+                step_size=float(self.getOrDefault("stepSize")),
+                boosting=True)
+        elif self.hasParam("numTrees"):     # bootstrap-forest shape
+            kwargs.update(
+                n_trees=int(self.getOrDefault("numTrees")),
+                feature_k=_feature_k(
+                    self.getOrDefault("featureSubsetStrategy"),
+                    source.n_features, self._is_classifier),
+                bootstrap=True,
+                subsample=float(self.getOrDefault("subsamplingRate")))
+        else:                               # single decision tree
+            kwargs.update(n_trees=1, feature_k=None, bootstrap=False,
+                          subsample=1.0)
+        spec = fit_ensemble_chunked(source, **kwargs)
+        cls = getattr(self, "_model_cls", None) \
+            or _CHUNKED_MODEL_FOR[type(self).__name__]
+        m = cls(spec)
+        m._inherit_params(self)
+        return m
+
 
 class DecisionTreeRegressor(_TreeEstimatorBase):
     def _init_params(self):
@@ -802,3 +856,13 @@ class GBTClassificationModel(_TreeClassificationModel):
 
 
 GBTClassifier._model_cls = GBTClassificationModel
+
+#: estimator -> model class for `_TreeEstimatorBase.fit_chunked` (the
+#: DT/RF classes construct their models inline in `_fit`; GBT's
+#: `_model_cls` attribute wins when present)
+_CHUNKED_MODEL_FOR = {
+    "DecisionTreeRegressor": DecisionTreeRegressionModel,
+    "DecisionTreeClassifier": DecisionTreeClassificationModel,
+    "RandomForestRegressor": RandomForestRegressionModel,
+    "RandomForestClassifier": RandomForestClassificationModel,
+}

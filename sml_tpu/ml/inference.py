@@ -506,77 +506,31 @@ class DeviceScorer:
         flight recorder on, every dispatch and drain emits an `infer.*`
         event, so the staging-of-batch-i+1-overlaps-compute-of-batch-i
         pipelining claim is ASSERTABLE from the event order (batch i+1's
-        dispatch lands before batch i's drain — tested)."""
-        from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
-
+        dispatch lands before batch i's drain — tested). The loop itself
+        is the shared `parallel.pipeline` staging pipeline — the same
+        machinery the out-of-core chunked ingest rides."""
         from ..conf import GLOBAL_CONF
-        from ..obs._recorder import RECORDER as _OBS
+        from ..parallel.pipeline import prefetch_map, prefetch_pipeline
         if depth is None:
             depth = max(GLOBAL_CONF.getInt("sml.infer.prefetchBatches"), 1)
         if self._factorized is not None:
             # factorized linear scoring is pure host numpy/pandas work:
-            # overlap batches on worker threads with BOUNDED lookahead —
-            # Executor.map would drain the whole source iterator eagerly.
-            # The window IS the prefetch depth (depth=1 → synchronous)
-            it = iter(batches)
-            with ThreadPoolExecutor(max_workers=min(depth, 4)) as ex:
-                window: deque = deque()
-
-                def pull() -> bool:
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        return False
-                    window.append(ex.submit(self.__call__, b))
-                    return True
-
-                for _ in range(depth):
-                    pull()
-                while window:
-                    out = window.popleft().result()
-                    pull()
-                    yield out
+            # bounded-lookahead thread map, no device dispatch to overlap
+            yield from prefetch_map(batches, self.__call__, depth=depth)
             return
-        pending: deque = deque()
 
-        def drain_one():
-            i, out, n, fin = pending.popleft()
-            res = fin(np.asarray(out, dtype=np.float64)[:n])
-            if _OBS.enabled:
-                _OBS.emit("infer", "infer.drain", args={"batch": i})
-            return res
+        def dispatch(_i, X):
+            out, n, fin = self._dispatch(X)
+            try:
+                out.copy_to_host_async()
+            except Exception:
+                pass
+            return out, n, fin
 
-        workers = 4
-        with ThreadPoolExecutor(max_workers=workers) as ex:
-            it = iter(batches)
-            preps: deque = deque()
+        def drain(_i, handle):
+            out, n, fin = handle
+            return fin(np.asarray(out, dtype=np.float64)[:n])
 
-            def submit_next() -> bool:
-                try:
-                    b = next(it)
-                except StopIteration:
-                    return False
-                preps.append(ex.submit(self._prep, b))
-                return True
-
-            for _ in range(workers):
-                submit_next()
-            dispatched = 0
-            while preps:
-                X = preps.popleft().result()
-                submit_next()
-                out, n, fin = self._dispatch(X)
-                if _OBS.enabled:
-                    _OBS.emit("infer", "infer.dispatch",
-                              args={"batch": dispatched})
-                try:
-                    out.copy_to_host_async()
-                except Exception:
-                    pass
-                pending.append((dispatched, out, n, fin))
-                dispatched += 1
-                if len(pending) >= depth:
-                    yield drain_one()
-            while pending:
-                yield drain_one()
+        yield from prefetch_pipeline(batches, self._prep, dispatch, drain,
+                                     depth=depth, workers=4, family="infer",
+                                     index_key="batch")

@@ -89,13 +89,22 @@ def bin_dtype(max_bins: int) -> np.dtype:
     return np.dtype(np.int32)
 
 
-def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
-              categorical: Optional[Dict[int, int]] = None,
-              max_categories_error: bool = True) -> Tuple[np.ndarray, Binning]:
-    """Host-side discretization. Continuous features: quantile edges.
-    Categorical slots: identity bins ordered by mean label; cardinality must
-    fit in max_bins, reproducing Spark's maxBins error (`ML 06:91-126`)."""
-    n, F = X.shape
+def finalize_binning(F: int, max_bins: int,
+                     categorical: Optional[Dict[int, int]],
+                     cont_quantiles: Dict[int, Optional[np.ndarray]],
+                     cat_means: Dict[int, np.ndarray],
+                     max_categories_error: bool = True):
+    """Assemble a `Binning` from per-feature quantile values + per-slot
+    category label means — the ONE edge-assembly shared by the monolithic
+    `make_bins` and the streamed-sketch path (`frame/_chunks.py`'s
+    DatasetSketch), so the two ingest paths cannot drift: same
+    unique/float32 edge collapse, same label-mean category ordering, same
+    maxBins cardinality error, same compact-dtype sizing.
+
+    `cont_quantiles[f]` is the raw `np.quantile` output for continuous
+    slot f (None/empty = no finite values — the slot bins to 0);
+    `cat_means[f]` is the per-category mean-label array (inf for absent
+    categories). Returns (Binning, edge_list, out_dtype)."""
     categorical = categorical or {}
     for slot, card in categorical.items():
         if card > max_bins and max_categories_error:
@@ -109,6 +118,39 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
     remaps: Dict[int, np.ndarray] = {}
     edge_list: list = [np.zeros(0, dtype=np.float32)] * F
     for f in range(F):
+        if f in categorical:
+            card = int(categorical[f])
+            means = cat_means[f]
+            order = np.argsort(means, kind="stable")
+            rank = np.empty(card, dtype=np.int32)
+            rank[order] = np.arange(card, dtype=np.int32)
+            remaps[f] = rank
+            edges[f, :] = np.inf  # traversal uses bins directly
+        else:
+            qs = cont_quantiles.get(f)
+            if qs is None or len(qs) == 0:
+                continue
+            qs = np.unique(np.asarray(qs).astype(np.float32))
+            edges[f, :len(qs)] = qs
+            edge_list[f] = qs
+    # dtype must hold the categorical ranks too: with
+    # max_categories_error=False a cardinality may legally exceed
+    # max_bins, and a uint8 matrix would silently wrap those ranks
+    need = max([max_bins] + [len(r) for r in remaps.values()])
+    return Binning(edges=edges, cat_remap=remaps), edge_list, bin_dtype(need)
+
+
+def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
+              categorical: Optional[Dict[int, int]] = None,
+              max_categories_error: bool = True) -> Tuple[np.ndarray, Binning]:
+    """Host-side discretization. Continuous features: quantile edges.
+    Categorical slots: identity bins ordered by mean label; cardinality must
+    fit in max_bins, reproducing Spark's maxBins error (`ML 06:91-126`)."""
+    n, F = X.shape
+    categorical = categorical or {}
+    cont_quantiles: Dict[int, Optional[np.ndarray]] = {}
+    cat_means: Dict[int, np.ndarray] = {}
+    for f in range(F):
         col = X[:, f]
         if f in categorical:
             card = int(categorical[f])
@@ -119,14 +161,11 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
                 sel = ids == c
                 if sel.any():
                     means[c] = float(y[sel].mean()) if y is not None else c
-            order = np.argsort(means, kind="stable")
-            rank = np.empty(card, dtype=np.int32)
-            rank[order] = np.arange(card, dtype=np.int32)
-            remaps[f] = rank
-            edges[f, :] = np.inf  # traversal uses bins directly
+            cat_means[f] = means
         else:
             finite = col[np.isfinite(col)]
             if len(finite) == 0:
+                cont_quantiles[f] = None
                 continue
             # edges from a deterministic subsample above 256k rows — the
             # same approximation Spark's approxQuantile binning and
@@ -135,16 +174,13 @@ def make_bins(X: np.ndarray, y: np.ndarray, max_bins: int,
             if len(finite) > 262_144:
                 stride = -(-len(finite) // 262_144)
                 finite = finite[::stride]
-            qs = np.quantile(finite, np.linspace(0, 1, max_bins + 1)[1:-1])
-            qs = np.unique(qs.astype(np.float32))
-            edges[f, :len(qs)] = qs
-            edge_list[f] = qs
-    # dtype must hold the categorical ranks too: with
-    # max_categories_error=False a cardinality may legally exceed
-    # max_bins, and a uint8 matrix would silently wrap those ranks
-    need = max([max_bins] + [len(r) for r in remaps.values()])
-    binned = _bin_columns(X, edge_list, remaps, bin_dtype(need))
-    return binned, Binning(edges=edges, cat_remap=remaps)
+            cont_quantiles[f] = np.quantile(
+                finite, np.linspace(0, 1, max_bins + 1)[1:-1])
+    binning, edge_list, out_dtype = finalize_binning(
+        F, max_bins, categorical, cont_quantiles, cat_means,
+        max_categories_error=max_categories_error)
+    binned = _bin_columns(X, edge_list, binning.cat_remap, out_dtype)
+    return binned, binning
 
 
 def _bin_columns(X: np.ndarray, edge_list, remaps: Dict[int, np.ndarray],

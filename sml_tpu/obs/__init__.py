@@ -65,12 +65,14 @@ from ._context import TraceContext, activate as activate_trace, \
 from ._ledger import LEDGER, report as memory_report
 from ._metrics import METRICS, LogHistogram, merge_snapshots
 from ._recorder import RECORDER, Event
-from ._skew import SKEW, report_from_trace as skew_report_from_trace
+from ._skew import INGEST_SKEW, SKEW, \
+    report_from_trace as skew_report_from_trace
 from ._trace import export_chrome_trace
 from ._watchdog import WATCHDOG, all_thread_stacks
 from .blackbox import dump_blackbox, install as install_blackbox
 
-__all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW", "WATCHDOG",
+__all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW", "INGEST_SKEW",
+           "WATCHDOG",
            "TraceContext", "current_trace", "new_trace", "activate_trace",
            "trace_hex", "all_thread_stacks", "dump_blackbox",
            "install_blackbox",
@@ -94,8 +96,20 @@ def reset() -> None:
     _audit.reset()
     METRICS.reset()
     SKEW.reset()
+    INGEST_SKEW.reset()
     WATCHDOG.reset()
     LEDGER.reset_peaks()
+
+
+def note_pipeline(family: str, phase: str, key: str, index: int) -> None:
+    """Staging-pipeline event emitter (`parallel/pipeline.py`):
+    `<family>.<phase>` with family "infer" (batch inference) or
+    "ingest" (chunked ingest) — both registered wildcard families. The
+    name is computed from the family parameter, and computed event
+    names are reserved to this package by the taxonomy lint, so the
+    shared pipeline emits through here."""
+    if RECORDER.enabled:
+        RECORDER.emit(family, family + "." + phase, args={key: index})
 
 
 def note_compile(name: str) -> None:
@@ -202,6 +216,11 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         "engine": engine_metrics(),
         "slo": slo_report(window_s),
         "skew": straggler_report(),
+        # chunked-ingest straggler attribution (ml/_chunked.py feeds
+        # per-chunk walls into the INGEST_SKEW tracker): same BSP report
+        # shape as `skew`, but "slowest_device" is the slowest CHUNK
+        # index — a slow ingest chunk is named here, not averaged away
+        "ingest": INGEST_SKEW.straggler_report(),
         # in-flight watchdog tickets (obs/_watchdog.py): what is running
         # RIGHT NOW, how long it has been, and whether it broke its own
         # prediction — the block a liveness probe reads during a hang

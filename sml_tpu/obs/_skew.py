@@ -59,10 +59,17 @@ def _stats(compute: Sequence[float]) -> Dict[str, object]:
 
 
 class SkewTracker:
-    """Accumulates per-program, per-device compute/wait attributions."""
+    """Accumulates per-program, per-device compute/wait attributions.
 
-    def __init__(self) -> None:
+    `prefix` names the event family the tracker emits under ("skew" for
+    the per-chip mesh tracker; "ingest" for the chunked data plane's
+    per-CHUNK tracker, whose "device" ids are chunk indices — the same
+    BSP decomposition names the slowest ingest chunk the way the mesh
+    tracker names the slowest chip)."""
+
+    def __init__(self, prefix: str = "skew") -> None:
         self._rec = RECORDER
+        self._prefix = prefix
         self._lock = threading.Lock()
         self._programs: List[Dict[str, object]] = []
         self._compute: Dict[int, float] = {}   # device -> total compute s
@@ -135,13 +142,16 @@ class SkewTracker:
         start = time.perf_counter() if t0 is None else float(t0)
         mx = entry["slowest_compute_s"]
         for d, c in zip(ids, compute):
-            self._rec.emit("span", "skew.compute", dur=c, ts=start,
-                           args={"device": d, "program": program})
+            # prefix is "skew" or "ingest" — both registered wildcard
+            # families in obs/taxonomy.py (the tracker is instantiated
+            # exactly twice: SKEW and INGEST_SKEW below)
+            self._rec.emit("span", f"{self._prefix}.compute", dur=c,
+                           ts=start, args={"device": d, "program": program})
             if mx - c > 0:
-                self._rec.emit("span", "skew.wait", dur=mx - c,
+                self._rec.emit("span", f"{self._prefix}.wait", dur=mx - c,
                                ts=start + c,
                                args={"device": d, "program": program})
-        self._rec.emit("skew", "skew.note", args={
+        self._rec.emit(self._prefix, f"{self._prefix}.note", args={
             "program": program, "n_devices": entry["n_devices"],
             "slowest_device": entry["slowest_device"],
             "skew_ratio": round(entry["skew_ratio"], 4),
@@ -225,3 +235,9 @@ def report_from_trace(trace_events: List[dict]) -> Optional[Dict[str, object]]:
 
 
 SKEW = SkewTracker()
+
+#: per-CHUNK attribution for the out-of-core ingest pipeline
+#: (ml/_chunked.py): "device" ids are CHUNK INDICES — the straggler
+#: report names the slowest ingest chunk, surfaced as the `ingest`
+#: block of obs.engine_health()
+INGEST_SKEW = SkewTracker("ingest")

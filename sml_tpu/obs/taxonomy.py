@@ -32,6 +32,9 @@ SPANS = {
     # per-device straggler attribution (obs/_skew.py): skew.compute /
     # skew.wait lanes rendered on the trace exporter's per-device process
     "skew.*",
+    # chunked-ingest per-CHUNK attribution lanes (the INGEST_SKEW
+    # tracker): ingest.compute / ingest.wait with "device" = chunk index
+    "ingest.*",
 }
 
 COUNTERS = {
@@ -39,6 +42,11 @@ COUNTERS = {
     "stall.*",
     # black-box postmortem (obs/blackbox.py): bundles written
     "blackbox.*",
+    # out-of-core data plane (frame/_chunks.py + ml/_chunked.py):
+    # ingest.chunks / ingest.rows / ingest.raw_bytes (float bytes the
+    # chunk plane SAW but never held whole) / ingest.h2d_bytes (compact
+    # chunk-block transfers) / ingest.sketch_compress / ingest.memo_hit
+    "ingest.*",
     "staging.cache_hit", "staging.cache_miss",
     "staging.bin_cache_hit", "staging.bin_cache_miss",
     "staging.h2d_bytes", "staging.d2h_bytes", "staging.h2d_bytes_saved",
@@ -94,6 +102,10 @@ EVENTS = {
     "compile.*",          # compile.trace / compile.cache_dir
     "serve.*",            # serve.swap (endpoint hot-swap receipts)
     "infer.*",            # infer.dispatch / infer.drain (batch pipelining)
+    "ingest.*",           # ingest.dispatch / ingest.drain (chunk-i+1
+                          # H2D overlapping chunk-i device work — the
+                          # double-buffered prefetch proof) + ingest.note
+                          # (per-chunk skew attribution summaries)
     "prewarm.*",          # prewarm.start / prewarm.replay / prewarm.done
     "skew.*",             # skew.note (per-program attribution summary)
                           # plus the skew.compute/skew.wait per-device
