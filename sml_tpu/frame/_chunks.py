@@ -376,6 +376,93 @@ class FeatureSketch:
     def exact(self) -> bool:
         return self._exact
 
+    def values_weights(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Consolidated (values, weights) view of the retained stream —
+        unsorted concatenation in insertion order; lazily-materialized
+        exact-mode weights come back as ones. The accessor the drift
+        engine (obs/drift.py) resamples and CDFs through without
+        reaching into the pending lists."""
+        if self._n == 0:
+            return (np.zeros(0, dtype=np.float64),
+                    np.zeros(0, dtype=np.float64))
+        vals = self._vals[0] if len(self._vals) == 1 \
+            else np.concatenate(self._vals)
+        wts = np.concatenate([np.ones(v.size, dtype=np.float64)
+                              if w is None else w
+                              for v, w in zip(self._vals, self._wts)])
+        return vals, wts
+
+    def cdf(self, xs: np.ndarray) -> np.ndarray:
+        """Weighted fraction of the stream at or below each x (empirical
+        CDF; exact over retained values, weight-interpolation-free over
+        compressed centroids). Zeros when the sketch is empty."""
+        xs = np.asarray(xs, dtype=np.float64)
+        if self._n == 0:
+            return np.zeros(xs.shape, dtype=np.float64)
+        v, w = self.values_weights()
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        cw = np.cumsum(w)
+        total = cw[-1]
+        idx = np.searchsorted(v, xs, side="right")
+        out = np.where(idx > 0, cw[np.maximum(idx - 1, 0)], 0.0)
+        return out / total
+
+    # ------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        """JSON-safe round-trip form (`from_dict` restores a sketch that
+        quantiles/cdfs BIT-IDENTICALLY and stays merge-compatible).
+        Exact mode serializes the raw value stream (weights omitted, so
+        the lazy-ones invariant survives the trip); compressed mode
+        serializes the (value, weight) centroids. Values record their
+        dtype so a float32 column stream quantiles on the same bits
+        after reload."""
+        if not self._exact and len(self._vals) > 1:
+            # consolidate pending post-compression chunks into the single
+            # sorted (value, weight) pair first: compressed-mode
+            # `quantiles()` reads exactly that snapshot, so serializing
+            # the raw pending lists would reload a sketch whose
+            # quantiles differ from the live object's
+            self._compress()
+        if self._n == 0:
+            vals = np.zeros(0, dtype=np.float64)
+            wts = None
+        else:
+            vals = self._vals[0] if len(self._vals) == 1 \
+                else np.concatenate(self._vals)
+            wts = None if all(w is None for w in self._wts) else \
+                np.concatenate([np.ones(v.size, dtype=np.float64)
+                                if w is None else w
+                                for v, w in zip(self._vals, self._wts)])
+        out = {
+            "buckets": self.buckets,
+            "exact_cap": self.exact_cap,
+            "n_seen": self.n_seen,
+            "compressions": self.compressions,
+            "exact": bool(self._exact),
+            "dtype": str(vals.dtype),
+            "values": np.asarray(vals, dtype=np.float64).tolist(),
+        }
+        if wts is not None:
+            out["weights"] = np.asarray(wts, dtype=np.float64).tolist()
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FeatureSketch":
+        sk = cls(buckets=int(d["buckets"]), exact_cap=int(d["exact_cap"]))
+        vals = np.asarray(d["values"], dtype=np.float64).astype(
+            np.dtype(d.get("dtype", "float64")))
+        sk.n_seen = int(d["n_seen"])
+        sk.compressions = int(d.get("compressions", 0))
+        sk._exact = bool(d.get("exact", True))
+        if vals.size:
+            sk._vals = [vals]
+            w = d.get("weights")
+            sk._wts = [None if w is None
+                       else np.asarray(w, dtype=np.float64)]
+            sk._n = int(vals.size)
+        return sk
+
     def quantiles(self, qs: np.ndarray) -> np.ndarray:
         """Quantile values at probabilities `qs`. Exact mode calls
         np.quantile on the raw values (bit parity with make_bins);
@@ -419,7 +506,7 @@ class DatasetSketch:
         self._cat_sum = {f: np.zeros(int(card), dtype=np.float64)
                          for f, card in self.categorical.items()}
         self._cat_cnt = {f: np.zeros(int(card), dtype=np.int64)
-                         for f in self.categorical}
+                         for f, card in self.categorical.items()}
         self.n_rows = 0
 
     def update(self, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
@@ -452,6 +539,41 @@ class DatasetSketch:
     @property
     def exact(self) -> bool:
         return all(sk.exact for sk in self.features.values())
+
+    # ------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        """JSON-safe round-trip of the whole dataset sketch (per-feature
+        quantile sketches + streamed categorical tables) — the baseline
+        persistence format of obs/drift.py and a checkpointable summary
+        for any interrupted ingest pass. `from_dict` restores a sketch
+        that is merge-compatible and quantile-bit-identical."""
+        return {
+            "n_features": self.n_features,
+            "n_rows": self.n_rows,
+            "categorical": {str(f): int(c)
+                            for f, c in sorted(self.categorical.items())},
+            "features": {str(f): sk.to_dict()
+                         for f, sk in sorted(self.features.items())},
+            "cat_sum": {str(f): self._cat_sum[f].tolist()
+                        for f in sorted(self.categorical)},
+            "cat_cnt": {str(f): self._cat_cnt[f].tolist()
+                        for f in sorted(self.categorical)},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DatasetSketch":
+        categorical = {int(f): int(c)
+                       for f, c in (d.get("categorical") or {}).items()}
+        out = cls(int(d["n_features"]), categorical)
+        out.n_rows = int(d.get("n_rows", 0))
+        out.features = {int(f): FeatureSketch.from_dict(sd)
+                        for f, sd in (d.get("features") or {}).items()}
+        for f in out.categorical:
+            out._cat_sum[f] = np.asarray(d["cat_sum"][str(f)],
+                                         dtype=np.float64)
+            out._cat_cnt[f] = np.asarray(d["cat_cnt"][str(f)],
+                                         dtype=np.int64)
+        return out
 
     def cat_means(self, with_labels: bool) -> Dict[int, np.ndarray]:
         """Per-category mean label (inf for absent categories) — the

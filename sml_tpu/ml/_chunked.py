@@ -51,6 +51,8 @@ class IngestResult(NamedTuple):
     n_rows: int
     n_padded: int
     stats: dict                 # per-ingest attribution (see docs/DATAPLANE.md)
+    sketch: Optional[DatasetSketch] = None  # the pass-1 full-data sketch
+                                # (drift-baseline source: obs/drift.py)
 
 
 #: fingerprint-keyed memo of completed ingests: a re-fit on the SAME
@@ -72,36 +74,60 @@ def _memo_key(source: ChunkSource, max_bins: int,
 
 
 def sketch_source(source: ChunkSource, max_bins: int,
-                  categorical: Optional[Dict[int, int]] = None
-                  ) -> DatasetSketch:
+                  categorical: Optional[Dict[int, int]] = None,
+                  monitor=None) -> DatasetSketch:
     """Ingest pass 1: one `DatasetSketch` PER CHUNK, merged into the
     unified sketch (the mergeable contract — per-chunk summaries built
     independently then unified, exactly how a multi-process ingest would
-    combine them)."""
+    combine them). `monitor` (an obs/drift.py DriftMonitor) judges each
+    chunk's sketch against a training baseline as it streams past — the
+    ingest-time drift monitor, at zero extra sketching cost."""
     unified = DatasetSketch(source.n_features, categorical)
-    for X, y in source.chunks():
+    for i, (X, y) in enumerate(source.chunks()):
         chunk_sk = DatasetSketch(source.n_features, categorical)
         chunk_sk.update(X, y)
+        if monitor is not None:
+            monitor.observe_sketch(chunk_sk, i)
         unified.merge(chunk_sk)
     return unified
 
 
 def ingest_source(source: ChunkSource, max_bins: int,
                   categorical: Optional[Dict[int, int]] = None,
-                  label: str = "source") -> IngestResult:
+                  label: str = "source",
+                  drift_baseline=None) -> IngestResult:
     """Two-pass streamed quantization of a ChunkSource into the engine's
     compact bin representation (module docstring has the pipeline
     shape). Returns the host mirror + binning with the assembled device
-    copy already adopted into the bin cache."""
-    key = _memo_key(source, max_bins, categorical)
+    copy already adopted into the bin cache.
+
+    `drift_baseline` (an obs/drift.py DriftBaseline — typically a
+    registered model's training baseline) arms the INGEST-TIME DRIFT
+    MONITOR: every chunk's pass-1 sketch is judged against it, flagged
+    chunks count `drift.chunk_flagged`, and the monitor registers as
+    "ingest" in `engine_health()["drift"]` — the refit-trigger signal
+    for continuous training. The "ingest" slot is LAST-WINS (the block
+    reflects the most recent monitored ingest; its `idle_s` field marks
+    how stale the verdicts are)."""
+    # a monitored ingest is a MONITORING PASS: it must actually stream
+    # the chunks against the caller's baseline, never be satisfied by a
+    # cached result (and never poison the cache for unmonitored reuse)
+    key = None if drift_baseline is not None \
+        else _memo_key(source, max_bins, categorical)
     hit = _ingest_memo.get(key) if key is not None else None
     if hit is not None:
         PROFILER.count("ingest.memo_hit")
         return hit
 
+    monitor = None
+    if drift_baseline is not None:
+        from ..obs import drift as _driftmod
+        monitor = _driftmod.DriftMonitor(drift_baseline, name="ingest")
+        _driftmod.DRIFT.register("ingest", monitor)
+
     # ---- pass 1: streamed sketch (counts rows, learns edges)
     t0 = now()
-    sketch = sketch_source(source, max_bins, categorical)
+    sketch = sketch_source(source, max_bins, categorical, monitor=monitor)
     binning, edge_list, out_dtype = sketch.to_binning(max_bins)
     n = sketch.n_rows
     sketch_s = now() - t0
@@ -222,7 +248,8 @@ def ingest_source(source: ChunkSource, max_bins: int,
             LEDGER.snapshot().get("chunk_stage", {}).get("peak", 0)),
     }
     out = IngestResult(binned=binned, y=y_out, binning=binning,
-                       n_rows=n, n_padded=n_padded, stats=stats)
+                       n_rows=n, n_padded=n_padded, stats=stats,
+                       sketch=sketch)
     if key is not None:
         while len(_ingest_memo) >= _INGEST_MEMO_ENTRIES:
             _ingest_memo.pop(next(iter(_ingest_memo)))
@@ -239,13 +266,18 @@ def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
                          seed: int = 17, loss: str = "squared",
                          step_size: float = 0.1, reg_lambda: float = 0.0,
                          gamma: float = 0.0, boosting: bool = False,
-                         rounds_per_dispatch: Optional[int] = None):
+                         rounds_per_dispatch: Optional[int] = None,
+                         drift_baseline=None):
     """Tree-ensemble fit end-to-end from a ChunkSource: streamed
     quantization, then the ordinary `_fit_ensemble` over the prebinned
     compact matrix — the raw float data is never resident whole on host
-    or device."""
+    or device. The ingest pass-1 sketch doubles as the fitted model's
+    drift baseline (full-data features, zero extra sketching);
+    `drift_baseline` additionally arms the per-chunk ingest monitor
+    against a PRIOR model's baseline (see `ingest_source`)."""
     from ._tree_models import _fit_ensemble
-    ing = ingest_source(source, max_bins, categorical, label="fit")
+    ing = ingest_source(source, max_bins, categorical, label="fit",
+                        drift_baseline=drift_baseline)
     if ing.y is None:
         raise ValueError("fit_ensemble_chunked needs a labeled ChunkSource "
                          "(chunks must yield (X, y) with y not None)")
@@ -256,7 +288,7 @@ def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
         bootstrap=bootstrap, subsample=subsample, seed=seed, loss=loss,
         step_size=step_size, reg_lambda=reg_lambda, gamma=gamma,
         boosting=boosting, rounds_per_dispatch=rounds_per_dispatch,
-        prebinned=(ing.binned, ing.binning))
+        prebinned=(ing.binned, ing.binning), baseline_sketch=ing.sketch)
 
 
 def iter_predictions(spec, source: ChunkSource):

@@ -73,6 +73,11 @@ def _feature_k(strategy: str, F: int, is_classification: bool) -> int:
 class _EnsembleSpec:
     """Host-side description of a fitted ensemble (persisted whole)."""
 
+    #: training drift baseline (obs/drift.py DriftBaseline), stamped by
+    #: `_fit_ensemble` and persisted as baseline.json next to data.npz —
+    #: the distribution a serving/ingest drift monitor compares against
+    baseline = None
+
     def __init__(self, trees: List[FittedTree], depth: int, binning: Binning,
                  tree_weights: Optional[np.ndarray], base: float,
                  n_features: int, mode: str):
@@ -139,6 +144,11 @@ class _EnsembleSpec:
             remap_slots=np.asarray(remap_keys, dtype=np.int64),
             **{f"remap_{k}": self.binning.cat_remap[k] for k in remap_keys},
         )
+        if self.baseline is not None:
+            import json as _json
+            import os as _os
+            with open(_os.path.join(path, "baseline.json"), "w") as f:
+                _json.dump(self.baseline.to_dict(), f)
 
     @classmethod
     def load(cls, path: str) -> "_EnsembleSpec":
@@ -149,9 +159,19 @@ class _EnsembleSpec:
                  zip(d["split_feature"], d["split_bin"], d["leaf_value"],
                      d["gain"], d["cover"])]
         tw = d["tree_weights"] if len(d["tree_weights"]) else None
-        return cls(trees, int(depth), Binning(edges=d["edges"], cat_remap=remap),
+        spec = cls(trees, int(depth),
+                   Binning(edges=d["edges"], cat_remap=remap),
                    tw, float(base), int(n_features),
                    "binary" if is_bin else "regression")
+        import os as _os
+        bp = _os.path.join(path, "baseline.json")
+        if _os.path.exists(bp):
+            import json as _json
+
+            from ..obs.drift import DriftBaseline
+            with open(bp) as f:
+                spec.baseline = DriftBaseline.from_dict(_json.load(f))
+        return spec
 
 
 import threading as _threading
@@ -215,7 +235,7 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                   gamma: float = 0.0, boosting: bool = False,
                   missing: Optional[float] = None,
                   rounds_per_dispatch: Optional[int] = None,
-                  prebinned=None) -> _EnsembleSpec:
+                  prebinned=None, baseline_sketch=None) -> _EnsembleSpec:
     """The one training path behind every tree learner: bin on host, then
     the WHOLE forest/boosting fit runs as a single on-device program
     (`tree_impl.fit_ensemble_on_device`).
@@ -264,9 +284,20 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
     mode = "binary" if loss == "logistic" else "regression"
     if boosting:
         weights = np.full(len(trees), step_size, dtype=np.float32)
-        return _EnsembleSpec(trees, max_depth, staged.binning, weights, base,
+        spec = _EnsembleSpec(trees, max_depth, staged.binning, weights,
+                             base, F, mode)
+    else:
+        spec = _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0,
                              F, mode)
-    return _EnsembleSpec(trees, max_depth, staged.binning, None, 0.0, F, mode)
+    # training drift baseline (obs/drift.py): features + label + the
+    # model's own training predictions, sketched from a strided
+    # subsample bounded by sml.obs.driftBaselineRows (the chunked path
+    # passes its full-data ingest sketch instead). Host-side numpy only
+    # — capture must not perturb the fit's program/dispatch counters
+    from ..obs import drift as _drift
+    spec.baseline = _drift.capture_fit_baseline(
+        X, y32, categorical, spec, binned=binned, sketch=baseline_sketch)
+    return spec
 
 
 def _fit_ensemble_folds(Xs, ys, cats, *, max_depth: int, max_bins: int,

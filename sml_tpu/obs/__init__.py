@@ -47,6 +47,13 @@ built on ONE structured event bus:
   postmortem bundles (ring + metrics + audit + ledger + in-flight
   tickets + stacks + conf) on unhandled exception, hard stall, or
   demand — rendered offline by `scripts/blackbox_view.py` without jax.
+- `drift` / `DRIFT` (`drift`): model & data drift — distribution
+  distances (per-feature PSI, quantile shift, categorical frequency
+  PSI, prediction-distribution drift) of live traffic against the
+  training baseline sketch fitted tree models carry, with noise-aware
+  thresholds (resampled-baseline self-distance floors so iid traffic
+  never false-positives); fed by the serving micro-batch path and the
+  chunked-ingest sketch pass, surfaced as `engine_health()["drift"]`.
 
 See docs/OBSERVABILITY.md for the event model and worked examples.
 """
@@ -59,6 +66,7 @@ from typing import Dict, Optional
 
 from ..conf import GLOBAL_CONF
 from . import _audit, _context, _ledger
+from . import drift as drift  # noqa: F401 — re-exported subsystem
 from ._audit import records as audit_records, report as audit_report
 from ._context import TraceContext, activate as activate_trace, \
     current as current_trace, hex_id as trace_hex, new_trace
@@ -70,9 +78,10 @@ from ._skew import INGEST_SKEW, SKEW, \
 from ._trace import export_chrome_trace
 from ._watchdog import WATCHDOG, all_thread_stacks
 from .blackbox import dump_blackbox, install as install_blackbox
+from .drift import DRIFT
 
 __all__ = ["RECORDER", "Event", "LEDGER", "METRICS", "SKEW", "INGEST_SKEW",
-           "WATCHDOG",
+           "WATCHDOG", "drift", "DRIFT",
            "TraceContext", "current_trace", "new_trace", "activate_trace",
            "trace_hex", "all_thread_stacks", "dump_blackbox",
            "install_blackbox",
@@ -99,6 +108,10 @@ def reset() -> None:
     INGEST_SKEW.reset()
     WATCHDOG.reset()
     LEDGER.reset_peaks()
+    # drift monitors drop their live windows/exemplars but STAY
+    # registered — they belong to live endpoints/ingests the way open
+    # watchdog tickets belong to real in-flight work
+    drift.DRIFT.reset()
 
 
 def note_pipeline(family: str, phase: str, key: str, index: int) -> None:
@@ -225,6 +238,12 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         # RIGHT NOW, how long it has been, and whether it broke its own
         # prediction — the block a liveness probe reads during a hang
         "inflight": WATCHDOG.report(),
+        # model & data drift (obs/drift.py): every registered monitor's
+        # live-vs-baseline verdict — serving endpoints under
+        # "serve.<name>/<stage>", the chunked ingest under "ingest" (per-chunk
+        # refit-trigger verdicts next to the `ingest` skew block above).
+        # None until a monitor registers (a model carrying a baseline)
+        "drift": drift.DRIFT.report(),
     }
     if RECORDER.enabled:
         RECORDER.emit("health", "health.snapshot", args={

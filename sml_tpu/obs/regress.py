@@ -21,7 +21,13 @@ didn't eyeball PERF.md closely enough. `compare()` is the machine check:
 - **serving percentiles**: load numbers on a shared host, judged at a
   generous 50%;
 - **coverage**: a leg present in the base but missing from the
-  candidate is itself a regression (silent coverage loss).
+  candidate is itself a regression (silent coverage loss);
+- **drift proofs**: the sidecar `drift` block's detection proof
+  (injected shift FLAGGED with the moved features named), its
+  no-false-positive proof (iid holdout CLEAN), and the baseline
+  save/load bit-compat check must not vanish or flip — a drift gate
+  that stops detecting, starts crying wolf, or loses its persisted
+  baseline is a monitoring regression even when every wall clock holds.
 
 STDLIB-ONLY by design: `scripts/bench_diff.py` loads this file by path
 (the graftlint pattern), so the CI gate runs in milliseconds without
@@ -101,6 +107,7 @@ def normalize(doc: dict) -> dict:
             "multichip": doc.get("multichip"),
             "kernel": doc.get("kernel"),
             "scale": doc.get("scale"),
+            "drift": doc.get("drift"),
             "shape": "sidecar",
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
@@ -126,6 +133,7 @@ def normalize(doc: dict) -> dict:
         "multichip": mc,
         "kernel": doc.get("kernel"),
         "scale": doc.get("scale"),
+        "drift": doc.get("drift"),
         "shape": "record",
     }
 
@@ -363,6 +371,55 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                 "regression",
                 "ingest dispatch/drain overlap proof vanished — prefetch "
                 "pipeline running serially"))
+
+    # ---- drift block (detection + no-false-positive proofs)
+    bdr, cdr = base.get("drift"), cand.get("drift")
+    if bdr and not cdr and cand.get("shape") != "record":
+        # coverage rule, like the kernel/scale blocks: a sidecar
+        # candidate missing the block actually lost the drift gate
+        # (bench.py carries it across plain suite runs); driver records
+        # can never carry it
+        reg.append(_finding(
+            "missing-drift-block", "drift", 1.0, 0.0, 0.0, "regression",
+            "drift block present in base, absent in candidate"))
+    if bdr and cdr:
+        bs, cs = bdr.get("shift") or {}, cdr.get("shift") or {}
+        bi, ci = bdr.get("iid") or {}, cdr.get("iid") or {}
+        if bs.get("flagged"):
+            checked += 1
+            if not cs.get("flagged"):
+                reg.append(_finding(
+                    "drift-detection", "shift.flagged", 1.0, 0.0, 0.0,
+                    "regression",
+                    "injected covariate shift no longer flagged — the "
+                    "detector went blind"))
+            elif bs.get("named_ok") and not cs.get("named_ok"):
+                reg.append(_finding(
+                    "drift-detection", "shift.named_ok", 1.0, 0.0, 0.0,
+                    "regression",
+                    "shift flagged but the moved features are no longer "
+                    "named"))
+        if bi and not bi.get("flagged"):
+            checked += 1
+            if not ci or ci.get("flagged") is not False:
+                # the no-false-positive proof either flipped (iid now
+                # flags) or vanished — both mean the threshold floor
+                # stopped doing its job
+                reg.append(_finding(
+                    "drift-false-positive", "iid.flagged", 0.0, 1.0, 0.0,
+                    "regression",
+                    "iid holdout no longer proven clean — noise-aware "
+                    "threshold floor lost"))
+        bb = (bdr.get("baseline") or {}).get("reload_bit_compat")
+        cb = (cdr.get("baseline") or {}).get("reload_bit_compat")
+        if bb:
+            checked += 1
+            if cb is not True:
+                reg.append(_finding(
+                    "drift-roundtrip", "baseline.reload_bit_compat", 1.0,
+                    0.0, 0.0, "regression",
+                    "baseline save/load round trip no longer "
+                    "bit-compatible (reload self-distance != 0)"))
 
     return {"ok": not reg, "regressions": reg, "improvements": imp,
             "checked": checked}

@@ -85,6 +85,16 @@ COUNTERS = {
     "serve.model_cache_hit", "serve.model_cache_miss",
     "serve.model_cache_evict_bytes",
     "serve.canary_mirrored",
+    # canary shadow scores that DIED (the _mirror worker raised): a dead
+    # canary must show up in canary_stats()/health_report() instead of
+    # silently reporting zero divergence
+    "serve.canary_error",
+    # model & data drift (obs/drift.py): drift.chunk_flagged counts
+    # ingest chunks whose sketch drifted past threshold (the
+    # refit-trigger signal); drift.observe_error counts serving
+    # observation callbacks that raised (observation must never fail a
+    # flush, but a dead observer must be visible)
+    "drift.*",
 }
 
 GAUGES = {
@@ -93,6 +103,11 @@ GAUGES = {
     "slo.*",              # slo.burn_rate: breach fraction vs the
                           # sml.serve.sloMillis error budget, stamped by
                           # obs.engine_health()
+    "drift.*",            # drift.max_severity / drift.features_flagged:
+                          # the worst live-vs-baseline distance (as a
+                          # multiple of its noise-aware threshold) and
+                          # the flagged-feature count, stamped by every
+                          # DriftMonitor.report()
 }
 
 EVENTS = {
@@ -125,12 +140,21 @@ EVENTS = {
     "stall.*",
     # black-box postmortem (obs/blackbox.py): blackbox.dump receipts
     "blackbox.*",
+    # model & data drift (obs/drift.py): drift.report (per-monitor
+    # verdict receipts with the flagged-feature list) and drift.chunk
+    # (one ingest chunk's sketch judged against the baseline)
+    "drift.*",
 }
 
 # streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency
 # and size distributions kept as log-bucketed counts, NOT recorder events
 METRICS_NAMES = {
     "serve.request_ms",   # micro-batcher admission -> result per request
+    "serve.canary_abs_diff",  # per mirrored request: max |shadow -
+                          # primary| prediction divergence, exemplar =
+                          # the request's trace id — canary_stats()
+                          # reports windowed quantiles and the literal
+                          # worst-diverging request from this histogram
     "dispatch.*",         # dispatch.host_ms / dispatch.device_ms: measured
                           # walls of routed programs (fed by the audit's
                           # attach path)

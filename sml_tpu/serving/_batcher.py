@@ -108,7 +108,14 @@ class MicroBatcher:
 
     `start=False` leaves the flush worker paused (`start()` arms it) —
     tests use this to stage a deterministic queue before the first
-    flush."""
+    flush.
+
+    `observer` (optional) is called after each successful device batch
+    with `(X, preds, traces)` — the concatenated feature block, the
+    finalized predictions, and a per-row trace-id array (−1 = untraced).
+    It feeds the drift monitors (obs/drift.py) and runs ONLY with the
+    recorder enabled (one attribute load otherwise); an observer that
+    raises is counted (`drift.observe_error`), never served."""
 
     def __init__(self, score_block: Callable[[np.ndarray], np.ndarray], *,
                  host_score: Optional[Callable] = None,
@@ -117,9 +124,11 @@ class MicroBatcher:
                  queue_rows: Optional[int] = None,
                  timeout_millis: Optional[int] = None,
                  host_fallback: Optional[bool] = None,
+                 observer: Optional[Callable] = None,
                  start: bool = True):
         self._score_block = score_block
         self._host_score = host_score
+        self._observer = observer
         conf = GLOBAL_CONF
         self.max_batch_rows = max(int(
             conf.getInt("sml.serve.maxBatchRows")
@@ -337,6 +346,20 @@ class MicroBatcher:
                                  (done - p.t_enqueue) * 1e3,
                                  exemplar=None if p.ctx is None
                                  else p.ctx.trace_id)
+            # drift observation (obs/drift.py): the scored block + its
+            # predictions + per-row trace ids feed the endpoint's live
+            # sketch window. Gated on the recorder (one attribute load
+            # disabled); results are already delivered above, so an
+            # observer failure is counted, never served as a 500
+            if self._observer is not None and _OBS.enabled:
+                try:
+                    traces = np.concatenate([
+                        np.full(p.n,
+                                -1 if p.ctx is None else p.ctx.trace_id,
+                                dtype=np.int64) for p in live])
+                    self._observer(X, out, traces)
+                except Exception:
+                    PROFILER.count("drift.observe_error")
         except BaseException as e:  # noqa: BLE001 — futures carry it
             for p in live:
                 p.future._set_error(e)

@@ -27,6 +27,9 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..conf import GLOBAL_CONF
+from ..obs import _context as _trace
+from ..obs import drift as _drift
+from ..obs._metrics import METRICS as _METRICS
 from ..obs._recorder import RECORDER as _OBS
 from ..tracking import _store
 from ..utils.profiler import PROFILER
@@ -75,7 +78,8 @@ class ServingEndpoint:
         self._canary_acc = 0.0
         self._shadow_inflight = 0
         self._canary = {"mirrored": 0, "rows": 0, "sum_abs_diff": 0.0,
-                        "max_abs_diff": 0.0}
+                        "max_abs_diff": 0.0, "errors": 0}
+        self._drift: Optional[_drift.DriftMonitor] = None
         self._shadow_pool: Optional[ThreadPoolExecutor] = None
         self._closed = False
         # opt-in manifest replay (sml.prewarm.enabled), once per process,
@@ -90,6 +94,7 @@ class ServingEndpoint:
             _store.on_stage_transition(self._listener)
         self._batcher = MicroBatcher(self._score_device,
                                      host_score=self._score_host,
+                                     observer=self._observe_scores,
                                      **batcher_kwargs)
 
     # ----------------------------------------------------------- resolution
@@ -127,6 +132,45 @@ class ServingEndpoint:
                     self._staging_scorer = self._cache.get(
                         self._name, v, lambda: _load_scorer(self._name, v))
                     self._staging_version = v
+        self._install_drift()
+
+    def _drift_key(self) -> str:
+        # stage is part of the identity: a Production and a Staging
+        # endpoint of the same model must not clobber each other's
+        # monitor registration
+        return f"serve.{self._name}/{self._stage}"
+
+    def _install_drift(self) -> None:
+        """(Re)bind the drift monitor to the CURRENT scorer's training
+        baseline (obs/drift.py): tree models carry one in their
+        persisted spec, so a registry version resolves WITH the
+        distribution it was trained on. Models without a baseline
+        (linear, pre-drift artifacts) serve unmonitored."""
+        key = self._drift_key()
+        spec = getattr(getattr(self._scorer, "_model", None), "_spec", None)
+        baseline = getattr(spec, "baseline", None)
+        old = self._drift
+        if baseline is None:
+            self._drift = None
+            if old is not None:
+                _drift.DRIFT.unregister(key, old)
+        elif old is not None and old.baseline is baseline:
+            # same version: re-assert the registration (self-heals if a
+            # same-keyed endpoint's close ever raced it away)
+            _drift.DRIFT.register(key, old)
+        else:
+            # a hot-swap re-baselines: the new version's training
+            # distribution is the comparison target from here on
+            mon = _drift.DriftMonitor(baseline, name=key)
+            self._drift = mon
+            _drift.DRIFT.register(key, mon)
+
+    def _observe_scores(self, X, preds, traces) -> None:
+        """MicroBatcher observer: feed the scored block into the live
+        drift window (no-op without a baseline-carrying model)."""
+        mon = self._drift
+        if mon is not None:
+            mon.observe_block(X, preds, traces)
 
     def _on_transition(self, name, version, stage, archived) -> None:
         if name != self._name or self._closed:
@@ -188,8 +232,14 @@ class ServingEndpoint:
     def _mirror(self, X: np.ndarray, fut: ScoreFuture) -> None:
         """Score the mirrored request on the Staging version's HOST route
         (the shadow must not contend for the production device queue) and
-        fold the divergence into the canary stats. Never raises into the
-        serving path."""
+        fold the divergence into the canary stats — both the running
+        sums AND the `serve.canary_abs_diff` metrics histogram (PR-7
+        core), with the request's trace id as the observation's exemplar
+        so `canary_stats()` can name the literal worst-diverging
+        request. Never raises into the serving path — but a failed
+        shadow COUNTS (`serve.canary_error` + the stats' `errors`
+        field): a dead canary reporting zero divergence forever is
+        exactly the silent failure this layer exists to name."""
         try:
             primary = np.asarray(fut.result(timeout=60.0), dtype=np.float64)
             scorer = self._staging_scorer
@@ -199,6 +249,8 @@ class ServingEndpoint:
                                 dtype=np.float64)
             diff = np.abs(shadow - primary)
             PROFILER.count("serve.canary_mirrored")
+            _METRICS.observe("serve.canary_abs_diff", float(diff.max()),
+                             exemplar=fut.trace_id)
             with self._canary_lock:
                 self._canary["mirrored"] += 1
                 self._canary["rows"] += int(diff.size)
@@ -206,7 +258,9 @@ class ServingEndpoint:
                 self._canary["max_abs_diff"] = max(
                     self._canary["max_abs_diff"], float(diff.max()))
         except BaseException:  # noqa: BLE001 — shadow must never serve 500s
-            pass
+            PROFILER.count("serve.canary_error")
+            with self._canary_lock:
+                self._canary["errors"] += 1
         finally:
             with self._canary_lock:
                 self._shadow_inflight -= 1
@@ -217,6 +271,18 @@ class ServingEndpoint:
         out["staging_version"] = self._staging_version
         out["mean_abs_diff"] = (out["sum_abs_diff"] / out["rows"]
                                 if out["rows"] else 0.0)
+        # windowed divergence quantiles + the literal worst-diverging
+        # request, from the serve.canary_abs_diff histogram (all-time
+        # sums above survive recorder-off phases; these fields need the
+        # recorder on while mirroring)
+        hist = _METRICS.histogram("serve.canary_abs_diff")
+        if hist is not None:
+            window = float(GLOBAL_CONF.getInt("sml.obs.metricsWindowSec"))
+            out["abs_diff_p50"] = hist.quantile(0.50, window)
+            out["abs_diff_p99"] = hist.quantile(0.99, window)
+            worst, tid = hist.worst()
+            out["worst_abs_diff"] = float(worst)
+            out["worst_trace"] = _trace.hex_id(tid)
         return out
 
     # ---------------------------------------------------------------- health
@@ -249,6 +315,9 @@ class ServingEndpoint:
             _store.remove_stage_listener(self._listener)
             self._listener = None
         self._batcher.close()
+        if self._drift is not None:
+            _drift.DRIFT.unregister(self._drift_key(), self._drift)
+            self._drift = None
         with self._canary_lock:
             pool, self._shadow_pool = self._shadow_pool, None
         if pool is not None:
