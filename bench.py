@@ -1150,15 +1150,184 @@ def run_kernelbench(rows: int = KERNELBENCH_ROWS) -> dict:
     }
 
 
+# ------------------------------------------- kernelbench inference autotuner
+KERNELBENCH_INFER_SHAPES = ((32, 4), (128, 6))   # (maxBins, maxDepth)
+KERNELBENCH_INFER_BATCHES = (8192, 49152)        # scoring batch widths
+KERNELBENCH_INFER_BLOCKS = (512, 2048, 8192)     # pallas block_rows sweep
+
+
+def run_kernelbench_infer(rows: int = KERNELBENCH_ROWS) -> dict:
+    """`--kernelbench` tentpole 2 (ISSUE 12): the traversal-kernel
+    AUTOTUNER. For each (model shape, maxBins, batch width) point, sweep
+    the candidate traversal specs — the XLA where-sum path plus the
+    fused `native/traverse_kernel.py` launch at several `block_rows`
+    schemes (the conf default `sml.infer.kernelBlockRows` among them) —
+    best-of-3 warm scoring dispatches apiece, then PERSIST the winner
+    into the prewarm manifest (`parallel.prewarm.record_tuned`), so
+    replica spin-up and later processes resolve the tuned spec without
+    re-sweeping (`sml.infer.autotune`).
+
+    Every candidate's predictions are checked bit-identical against the
+    XLA path (the interpret-mode contract on non-TPU backends, where
+    these walls measure emulation overhead, not kernel speed — the
+    `interpret` flag says which kind of run this is). `replay_ok` proves
+    the round trip: with the sweep conf restored, the live resolver
+    returns each point's persisted winner from the manifest alone, and
+    the `infer_kernel` prewarm rebuilder replays one entry clean.
+    Results merge into the sidecar as the `kernel_infer` block —
+    separate from the fit sweep's `kernel` block, so the two coexist —
+    rendered by scripts/render_perf.py; `obs/regress.py` flags a
+    vanished block, fallback growth, or a lost beats-default/replay
+    proof."""
+    import jax
+
+    from sml_tpu import obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml import inference, tree_impl
+    from sml_tpu.ml._tree_models import _fit_ensemble
+    from sml_tpu.parallel import prewarm
+    from sml_tpu.utils.profiler import PROFILER
+
+    rng = np.random.default_rng(11)
+    F = 10
+    n_fit = min(rows, 60_000)
+    X = rng.normal(size=(n_fit, F)).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] ** 2 + 0.3 * X[:, 3]
+         + rng.normal(0, 0.3, n_fit)).astype(np.float32)
+    Xs = rng.normal(size=(max(KERNELBENCH_INFER_BATCHES), F)) \
+        .astype(np.float32)
+
+    prev = {k: GLOBAL_CONF.get(k) for k in
+            ("sml.obs.enabled", "sml.infer.kernel",
+             "sml.infer.kernelBlockRows", "sml.infer.autotune")}
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    GLOBAL_CONF.set("sml.infer.autotune", False)  # sweep forces specs
+    default_rows = int(prev["sml.infer.kernelBlockRows"])
+    legs = []
+    tuned = []
+    t_sweep0 = time.perf_counter()
+    obs.reset()
+    try:
+        for max_bins, max_depth in KERNELBENCH_INFER_SHAPES:
+            spec = _fit_ensemble(
+                X, y, categorical={}, max_depth=max_depth,
+                max_bins=max_bins, min_instances=1, min_info_gain=0.0,
+                n_trees=KERNELBENCH_TREES, feature_k=None, bootstrap=True,
+                subsample=1.0, seed=7, loss="squared")
+            sf, sb, lv, w = spec.stacked()
+            for batch in KERNELBENCH_INFER_BATCHES:
+                binned = tree_impl.bin_with(
+                    np.asarray(Xs[:batch], np.float64), spec.binning)
+
+                def score():
+                    return inference.predict_forest_sharded(
+                        binned, sf, sb, lv, w, spec.depth,
+                        base=spec.base, n_bins=max_bins)
+
+                # the conf default is ALWAYS a candidate (the spec the
+                # winner must beat), whatever the knob is set to
+                blocks = sorted(set(KERNELBENCH_INFER_BLOCKS)
+                                | {default_rows})
+                cands = [("xla", 0)] + [("pallas", br) for br in blocks]
+                entry = {"max_bins": max_bins, "max_depth": max_depth,
+                         "batch_rows": batch, "candidates": []}
+                preds = {}
+                for kern, br in cands:
+                    GLOBAL_CONF.set("sml.infer.kernel", kern)
+                    GLOBAL_CONF.set("sml.infer.kernelBlockRows",
+                                    br or default_rows)
+                    preds[(kern, br)] = score()  # warmup: compile
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        score()
+                        best = min(best, time.perf_counter() - t0)
+                    entry["candidates"].append(
+                        {"kernel": kern, "block_rows": br,
+                         "seconds": round(best, 4)})
+                xla_pred = preds[("xla", 0)]
+                entry["parity_ok"] = all(
+                    np.array_equal(xla_pred, p) for p in preds.values())
+                default_s = next(
+                    c["seconds"] for c in entry["candidates"]
+                    if c["kernel"] == "pallas"
+                    and c["block_rows"] == default_rows)
+                winner = min(entry["candidates"], key=lambda c: c["seconds"])
+                entry["default_s"] = default_s
+                entry["best_s"] = winner["seconds"]
+                entry["best_spec"] = {"kernel": winner["kernel"],
+                                      "block_rows": winner["block_rows"]}
+                entry["beats_default"] = winner["seconds"] < default_s
+                key = inference.infer_spec_key(
+                    sf.shape[0], spec.depth, F, max_bins, batch)
+                prewarm.record_tuned("infer_kernel", key,
+                                     entry["best_spec"])
+                tuned.append((key, entry["best_spec"]))
+                legs.append(entry)
+                print(f"  infer b{max_bins} d{max_depth} n{batch}: "
+                      f"default {default_s:.4f}s, best "
+                      f"{winner['seconds']:.4f}s "
+                      f"({winner['kernel']}/{winner['block_rows']}, "
+                      f"parity={entry['parity_ok']})", file=sys.stderr)
+        sweep_s = time.perf_counter() - t_sweep0
+        PROFILER.count("infer.kernel.autotune_s", float(sweep_s))
+        # round-trip proof: the live resolver must return each persisted
+        # winner from the manifest WITHOUT a sweep, and the prewarm
+        # rebuilder must replay an entry clean (replica spin-up's path)
+        for k in ("sml.infer.kernel", "sml.infer.kernelBlockRows"):
+            GLOBAL_CONF.set(k, prev[k])
+        GLOBAL_CONF.set("sml.infer.autotune", True)
+        replay_ok = True
+        for key, spec_rec in tuned:
+            kern, br, was_tuned = inference.resolve_infer_kernel(
+                n_trees=key["trees"], depth=key["depth"],
+                n_nodes=2 ** (key["depth"] + 1) - 1,
+                n_feat=key["features"], n_bins=key["bins"],
+                n_rows=key["rows"])
+            if (kern, br) != (spec_rec["kernel"], spec_rec["block_rows"]) \
+                    or not was_tuned:
+                replay_ok = False
+        try:
+            inference._replay_infer_kernel(
+                {"key": tuned[0][0], "spec": tuned[0][1]})
+        except Exception:
+            replay_ok = False
+        fallbacks = float(obs.RECORDER.counters()
+                          .get("infer.kernel.fallback", 0.0))
+    finally:
+        for k, v in prev.items():
+            GLOBAL_CONF.set(k, v)
+    return {
+        "rows": n_fit, "n_features": F, "n_trees": KERNELBENCH_TREES,
+        "backend": jax.default_backend(),
+        "interpret": jax.default_backend() != "tpu",
+        "default_block_rows": default_rows,
+        "note": "best-of-3 warm scoring dispatches per candidate spec; "
+                "winners persisted to the prewarm manifest "
+                "(record_tuned) and resolved back without a sweep "
+                "(replay_ok); on non-TPU backends pallas runs in "
+                "interpret mode (parity, not speed — docs/KERNELS.md)",
+        "legs": legs,
+        "fallbacks": fallbacks,
+        "tuned_beats_default": any(e["beats_default"] for e in legs),
+        "replay_ok": replay_ok,
+        "autotune_sweep_s": round(sweep_s, 3),
+    }
+
+
 def kernelbench_main(rows: int) -> None:
-    """Run the kernel sweep standalone, merge the `kernel` block into the
-    bench sidecar, and print the short headline JSON last."""
+    """Run the fit-kernel sweep AND the inference autotuner standalone,
+    merge their blocks into the bench sidecar — `kernel` (fit) and
+    `kernel_infer` (scoring) are SEPARATE keys so neither run clobbers
+    the other — and print the short headline JSON last."""
     block = run_kernelbench(rows)
+    infer_block = run_kernelbench_infer(rows)
     doc = {}
     if os.path.exists(LEGS_FILE):
         with open(LEGS_FILE) as f:
             doc = json.load(f)
     doc["kernel"] = block
+    doc["kernel_infer"] = infer_block
     with open(LEGS_FILE, "w") as f:
         json.dump(doc, f, indent=1)
     best = max(e["pallas_vs_xla"] for e in block["legs"])
@@ -1168,9 +1337,13 @@ def kernelbench_main(rows: int) -> None:
         "unit": "x vs xla path (best leg)",
         "backend": block["backend"],
         "interpret": block["interpret"],
-        "parity_ok": all(e["parity_ok"] for e in block["legs"]),
+        "parity_ok": all(e["parity_ok"] for e in block["legs"])
+        and all(e["parity_ok"] for e in infer_block["legs"]),
         "fallbacks": sum(e["kernel_counters"]["kernel.fallback"]
-                         for e in block["legs"]),
+                         for e in block["legs"])
+        + infer_block["fallbacks"],
+        "infer_tuned_beats_default": infer_block["tuned_beats_default"],
+        "infer_replay_ok": infer_block["replay_ok"],
         "legs_file": "bench_legs.json",
     }))
 
@@ -1867,7 +2040,8 @@ def main():
         try:
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
-            for block in ("multichip", "kernel", "scale", "drift"):
+            for block in ("multichip", "kernel", "kernel_infer", "scale",
+                          "drift"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):

@@ -189,6 +189,36 @@ _register("sml.training.module-name", "", str,
           "(courseware.CourseConfig)")
 _register("sml.training.username", "", str,
           "Course username stamped by the Classroom-Setup shim")
+_register("sml.infer.kernel", "auto", str,
+          "Ensemble-traversal implementation for device-routed scoring "
+          "(DeviceScorer.score_block / forest predict+eval programs): "
+          "'xla' = the one-hot where-sum HLO chain (the pre-kernel path, "
+          "kept verbatim); 'pallas' = the fused "
+          "sml_tpu/native/traverse_kernel.py batched-traversal kernel "
+          "(level-order SoA node tables resident in VMEM, depth-unrolled "
+          "predicated descent, leaf sums accumulated in-register; runs "
+          "in interpret mode on non-TPU backends — the tier-1 bit-parity "
+          "testing story); 'auto' = pallas on real TPU only, xla "
+          "everywhere else. Unavailable pallas falls back to xla and "
+          "counts infer.kernel.fallback. See docs/KERNELS.md")
+_register("sml.infer.kernelBlockRows", 2048, int,
+          "Row-block size of the pallas traversal kernel's grid on "
+          "hardware (bounds the VMEM per-level one-hot tile to "
+          "~blockRows*(n_nodes+F) elements; the actual block is the "
+          "largest divisor of the per-chip padded rows at or under "
+          "this). The hand-set default the --kernelbench autotuner "
+          "exists to beat: a tuned spec from the prewarm manifest "
+          "overrides this per (model shape, batch width) when "
+          "sml.infer.autotune is on. Interpret mode always runs ONE "
+          "block (the traversal has no cross-row reduction, so blocking "
+          "never changes results — bit-parity either way)")
+_register("sml.infer.autotune", True, _to_bool,
+          "Consult the prewarm manifest's autotuned traversal-kernel "
+          "specs (persisted by bench.py --kernelbench) when resolving "
+          "the scoring kernel: a recorded winner for this (model shape, "
+          "maxBins, batch width) on this mesh overrides sml.infer.kernel"
+          "/kernelBlockRows, so replicas and replays pick the tuned "
+          "spec without re-sweeping. Off = conf-resolved spec only")
 _register("sml.infer.prefetchBatches", 4, int,
           "DeviceScorer.score_batches lookahead: batches dispatched ahead "
           "of the drain point so batch i+1's prep + H2D staging overlaps "

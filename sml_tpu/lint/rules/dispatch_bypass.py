@@ -15,6 +15,14 @@ kernel is a compile AND a device launch the routing audit, the
 so kernels live only in the sanctioned `sml_tpu/native/` module
 (docs/KERNELS.md).
 
+Also flagged: direct invocation of the traversal kernel entry,
+`forest_traverse(...)` / `traverse_kernel.forest_traverse(...)`, outside
+the `score_block` dispatch glue (`ml/inference.py`'s
+`_forest_margin_path`) — mirroring the fit-kernel fence. A bypassing
+call skips `resolve_infer_kernel`, so the VMEM demotion guard, the
+autotuned-spec lookup, and the `infer.kernel.*` counters never see the
+launch.
+
 Suppression is an explicit ALLOWLIST of (file, enclosing function)
 pairs — or a directory prefix ending in "/" — each carrying its
 justification (the blessed compile owners), plus the usual
@@ -48,6 +56,17 @@ ALLOWLIST: Dict[str, Dict[str, str]] = {
         "form:pallas_call": "THE sanctioned custom-kernel module: every "
                             "pallas_call here is counted and "
                             "fallback-governed",
+        "form:forest_traverse": "kernel modules may compose their own "
+                                "entries (self-tests, wrappers); counts "
+                                "and fallback governance live here",
+    },
+    "sml_tpu/ml/inference.py": {
+        "_forest_margin_path": "THE sanctioned traversal-kernel "
+                               "invocation site: every forest_traverse "
+                               "launch is resolved by "
+                               "resolve_infer_kernel (VMEM guard, tuned "
+                               "specs, infer.kernel.* counters) before "
+                               "reaching it",
     },
     "sml_tpu/ml/_staging.py": {
         "data_parallel": "THE blessed jit+shard_map compile helper; every "
@@ -89,6 +108,14 @@ def _is_jax_jit_expr(e: ast.expr) -> bool:
     return False
 
 
+def _is_traverse_kernel_expr(e: ast.expr) -> bool:
+    """The traversal-kernel entry, any spelling: bare `forest_traverse`
+    or `<alias>.forest_traverse` (the import alias is caller-chosen)."""
+    if isinstance(e, ast.Attribute):
+        return e.attr == "forest_traverse"
+    return isinstance(e, ast.Name) and e.id == "forest_traverse"
+
+
 def _compile_site(node: ast.expr) -> Optional[str]:
     """A human label when `node` is a compile constructor, else None."""
     if _is_jax_jit_expr(node):
@@ -97,6 +124,9 @@ def _compile_site(node: ast.expr) -> Optional[str]:
         if _is_jax_jit_expr(node.func):
             return ast.unparse(node.func) if hasattr(ast, "unparse") \
                 else "jax.jit"
+        if _is_traverse_kernel_expr(node.func):
+            return ast.unparse(node.func) if hasattr(ast, "unparse") \
+                else "forest_traverse"
         # partial(jax.jit, ...) — the decorator spelling for static args
         if (isinstance(node.func, ast.Name) and node.func.id == "partial"
                 and node.args and _is_jax_jit_expr(node.args[0])):
@@ -131,6 +161,21 @@ def check(project: Project) -> List[Violation]:
             # form-scoped entries bless one compile FORM file-wide
             # (the native/ directory blesses pallas_call, not jax.jit)
             if "pallas_call" in label and "form:pallas_call" in allow:
+                return
+            if "forest_traverse" in label \
+                    and "form:forest_traverse" in allow:
+                return
+            if "forest_traverse" in label:
+                out.append(Violation(
+                    "dispatch-bypass", f.rel, node.lineno,
+                    f"direct traversal-kernel invocation `{label}` in "
+                    f"`{qual}` bypasses the score_block dispatch path "
+                    f"(resolve_infer_kernel's VMEM guard, autotuned "
+                    f"specs, and infer.kernel.* counters never see the "
+                    f"launch) — score through DeviceScorer/"
+                    f"predict_forest_sharded (ml.inference."
+                    f"_forest_margin_path is the one sanctioned call "
+                    f"site) or add an allowlist entry with a reason"))
                 return
             fix = ("move the kernel into sml_tpu/native/ (the sanctioned "
                    "kernel module behind tree_impl._kernel_choice)"

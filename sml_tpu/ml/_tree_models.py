@@ -117,8 +117,9 @@ class _EnsembleSpec:
                 # rows shard over the mesh; tree tensors replicate (P8 path)
                 from .inference import predict_forest_sharded
                 sf, sb, lv, w = self.stacked()
-                return predict_forest_sharded(binned, sf, sb, lv, w,
-                                              self.depth, base=self.base)
+                return predict_forest_sharded(
+                    binned, sf, sb, lv, w, self.depth, base=self.base,
+                    n_bins=self.binning.edges.shape[1] + 1)
             import jax
             with dispatch.observe_host("traverse", hint.flops), \
                     jax.default_device(list(mesh.devices.flat)[0]):
@@ -531,10 +532,15 @@ def fused_reg_stats_from_matrix(spec, X: np.ndarray, lab: np.ndarray,
     with routed_for(hint, binned_q, l32, f32) as mesh:
         if dispatch.is_host_mesh(mesh):
             return None  # host route: ordinary path is cheaper
-        from .inference import forest_eval_fn
+        from .inference import forest_eval_fn, resolve_infer_kernel
         sf, sb, lv, w = spec.stacked()
+        kernel, block_rows, _ = resolve_infer_kernel(
+            n_trees=sf.shape[0], depth=spec.depth, n_nodes=sf.shape[1],
+            n_feat=binned_q.shape[1],
+            n_bins=spec.binning.edges.shape[1] + 1, n_rows=n)
         stats = run_data_parallel(
-            forest_eval_fn(spec.depth, link), binned_q, l32, f32,
+            forest_eval_fn(spec.depth, link, kernel, block_rows),
+            binned_q, l32, f32,
             replicated=(np.asarray(sf), np.asarray(sb),
                         np.asarray(lv, dtype=np.float32),
                         np.asarray(w, dtype=np.float32),

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel import mesh as meshlib
+from ..utils.profiler import PROFILER
 from ._staging import cached_data_parallel, extract_features
 from ..parallel import collectives as coll
 
@@ -87,41 +88,219 @@ def _forest_margin(binned_b, sf, sb, lv, weights, depth: int):
     return jnp.sum(weights.astype(jnp.float32)[:, None] * per_tree, axis=0)
 
 
+# -------------------------------------------------- traversal-kernel choice
+#: last resolved traversal spec + fallback/demotion counts — the
+#: `infer_kernel` block of obs.engine_health() (kernel_report below)
+_KERNEL_STATE: dict = {"kernel": None, "block_rows": 0, "tuned": False,
+                       "resolutions": 0, "fallbacks": 0, "demotions": 0}
+
+
+def _infer_kernel_choice() -> str:
+    """Resolve `sml.infer.kernel` to the concrete scoring path ("pallas"
+    / "xla") for the ACTIVE mesh — the same fallback ladder as the fit
+    side's `tree_impl._kernel_choice` (docs/KERNELS.md): 'xla'
+    short-circuits; 'pallas' requires the toolchain probe and otherwise
+    falls back counting `infer.kernel.fallback`; 'auto' only ever
+    selects pallas on a real TPU mesh."""
+    from ..conf import GLOBAL_CONF
+    mode = str(GLOBAL_CONF.get("sml.infer.kernel")).strip().lower()
+    if mode not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"sml.infer.kernel must be one of auto/pallas/xla, got {mode!r}")
+    if mode == "xla":
+        return "xla"
+    from .tree_impl import _mesh_platform
+    if mode == "auto" and _mesh_platform() != "tpu":
+        return "xla"  # auto: never emulate on non-TPU backends
+    from ..native import traverse_kernel as _tk
+    if _tk.available():
+        return "pallas"
+    PROFILER.count("infer.kernel.fallback")
+    _KERNEL_STATE["fallbacks"] += 1
+    return "xla"
+
+
+def infer_spec_key(n_trees: int, depth: int, n_feat: int, n_bins: int,
+                   n_rows: int) -> dict:
+    """The autotuner's lookup key: (model shape, maxBins, batch width).
+    `rows` is the BUCKETED padded batch width — the shape the staged
+    program actually compiles for, so near-size batches share one tuned
+    spec exactly as they share one executable."""
+    mesh = meshlib.get_mesh()
+    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    return {"trees": int(n_trees), "depth": int(depth),
+            "features": int(n_feat), "bins": int(n_bins),
+            "rows": int(meshlib.bucket_rows(n_rows, n_dev))}
+
+
+def _note_spec(kernel: str, block_rows: int, tuned: bool) -> None:
+    changed = (_KERNEL_STATE["kernel"] != kernel
+               or _KERNEL_STATE["block_rows"] != block_rows
+               or _KERNEL_STATE["tuned"] != tuned)
+    _KERNEL_STATE.update(kernel=kernel, block_rows=int(block_rows),
+                         tuned=bool(tuned))
+    _KERNEL_STATE["resolutions"] += 1
+    PROFILER.count(f"infer.kernel.{kernel}")
+    if changed:
+        from ..obs._recorder import RECORDER
+        if RECORDER.enabled:
+            RECORDER.emit("infer", "infer.kernel.spec", args={
+                "kernel": kernel, "block_rows": int(block_rows),
+                "tuned": bool(tuned)})
+
+
+def _vmem_guard(block_rows: int, n_trees: int, n_nodes: int,
+                n_feat: int):
+    """Real-TPU VMEM guard for a pallas candidate → (block_rows,
+    demoted). The block target shrinks to the largest block that fits
+    `TRAVERSE_VMEM_BUDGET` (single source of the arithmetic:
+    `traverse_kernel.max_block_rows`); a spec whose resident node
+    tables alone bust the budget — oversized (block_rows × trees) at
+    ANY useful block — demotes (0, True). Interpret mode (non-TPU) has
+    no VMEM and never clamps or demotes."""
+    from .tree_impl import _mesh_platform
+    if _mesh_platform() != "tpu":
+        return block_rows, False
+    from ..native import traverse_kernel as _tk
+    mb = _tk.max_block_rows(n_trees, n_nodes, n_feat)
+    if mb == 0:
+        return 0, True
+    return min(block_rows, mb), False
+
+
+def resolve_infer_kernel(n_trees: int, depth: int, n_nodes: int,
+                         n_feat: int, n_bins: int, n_rows: int):
+    """Per-dispatch traversal-spec resolution → (kernel, block_rows,
+    tuned). `tuned` is the provenance of THIS resolution (returned, not
+    re-read from shared state — concurrent scorers resolve interleaved).
+
+    Order: (1) an AUTOTUNED spec from the prewarm manifest
+    (`sml.infer.autotune`, recorded by `bench.py --kernelbench`) wins for
+    its exact (model shape, maxBins, batch width) on this mesh — replicas
+    and replays pick the tuned kernel without re-sweeping; (2) otherwise
+    the conf ladder (`sml.infer.kernel` + `sml.infer.kernelBlockRows`).
+    EVERY pallas candidate — tuned or conf — passes the real-TPU VMEM
+    guard (`_vmem_guard`): the block clamps to the budget, and an
+    unfittable spec falls back to xla with `infer.kernel.fallback` +
+    demotion counts instead of failing to lower mid-trace. The resolved
+    pair keys the program cache and the prewarm signature, so a change
+    compiles fresh."""
+    from ..conf import GLOBAL_CONF
+    if GLOBAL_CONF.getBool("sml.infer.autotune"):
+        from ..parallel import prewarm as _prewarm
+        key = infer_spec_key(n_trees, depth, n_feat, n_bins, n_rows)
+        spec = _prewarm.tuned_spec("infer_kernel", key)
+        if spec is not None:
+            kernel = str(spec.get("kernel", "xla"))
+            block_rows = int(spec.get("block_rows", 0))
+            tuned = True
+            if kernel == "pallas":
+                from ..native import traverse_kernel as _tk
+                if not _tk.available():
+                    PROFILER.count("infer.kernel.fallback")
+                    _KERNEL_STATE["fallbacks"] += 1
+                    kernel, block_rows, tuned = "xla", 0, False
+                else:
+                    block_rows, demoted = _vmem_guard(
+                        block_rows, n_trees, n_nodes, n_feat)
+                    if demoted:
+                        # a tuned spec recorded on a roomier mesh (or a
+                        # changed budget) must not lower over-budget on
+                        # the serving hot path: same ladder as conf
+                        PROFILER.count("infer.kernel.fallback")
+                        _KERNEL_STATE["fallbacks"] += 1
+                        _KERNEL_STATE["demotions"] += 1
+                        kernel, block_rows, tuned = "xla", 0, False
+            _note_spec(kernel, block_rows, tuned=tuned)
+            return kernel, block_rows, tuned
+    kernel = _infer_kernel_choice()
+    if kernel != "pallas":
+        _note_spec("xla", 0, tuned=False)
+        return "xla", 0, False
+    block_rows, demoted = _vmem_guard(
+        GLOBAL_CONF.getInt("sml.infer.kernelBlockRows"),
+        n_trees, n_nodes, n_feat)
+    if demoted:
+        PROFILER.count("infer.kernel.fallback")
+        _KERNEL_STATE["fallbacks"] += 1
+        _KERNEL_STATE["demotions"] += 1
+        _note_spec("xla", 0, tuned=False)
+        return "xla", 0, False
+    _note_spec("pallas", block_rows, tuned=False)
+    return "pallas", int(block_rows), False
+
+
+def kernel_report() -> dict:
+    """The `infer_kernel` block of `obs.engine_health()`: the last
+    resolved traversal spec (kernel, block rows, whether it came from
+    the autotuned manifest) and the cumulative fallback/demotion
+    counts — a replica silently scoring off the tuned path shows up
+    here, not just in the counters."""
+    return dict(_KERNEL_STATE)
+
+
+def _forest_margin_path(binned_b, sf, sb, lv, weights, depth: int,
+                        kernel: str, block_rows: int):
+    """THE switch between the XLA where-sum traversal and the fused
+    `native/traverse_kernel.py` launch — the one sanctioned invocation
+    site of `forest_traverse` (graftlint's dispatch-bypass rule fences
+    it here, mirroring the fit-kernel fence). The mask multiply, base
+    offset, and eval psums stay in the callers, so both paths share
+    every op outside the traversal itself."""
+    if kernel == "pallas":
+        from ..native import traverse_kernel as _tk
+        from .tree_impl import _mesh_platform
+        interp = _mesh_platform() != "tpu"
+        return _tk.forest_traverse(binned_b, sf, sb, lv, weights,
+                                   depth=depth, interpret=interp,
+                                   block_rows=block_rows or None)
+    return _forest_margin(binned_b, sf, sb, lv, weights, depth)
+
+
 _forest_forwards: dict = {}
 
 
-def _make_forest_forward(depth: int):
-    """Memoized per depth: the prewarm manifest replays forest programs
-    through this factory, and program caches key on fn IDENTITY — a
-    fresh closure per call would compile a parallel universe of
-    executables instead of warming the live ones."""
-    fn = _forest_forwards.get(depth)
+def _make_forest_forward(depth: int, kernel: str = "xla",
+                         block_rows: int = 0):
+    """Memoized per (depth, kernel, block_rows): the prewarm manifest
+    replays forest programs through this factory, and program caches key
+    on fn IDENTITY — a fresh closure per call would compile a parallel
+    universe of executables instead of warming the live ones. The
+    resolved traversal spec is part of the identity (and the `_prewarm`
+    meta) so a tuned-spec change compiles fresh and replay rebuilds the
+    RECORDED spec regardless of live conf."""
+    key = (depth, kernel, block_rows)
+    fn = _forest_forwards.get(key)
     if fn is None:
         def forest_forward(binned_b, mask, sf, sb, lv, weights):
-            return _forest_margin(binned_b, sf, sb, lv, weights, depth) * mask
+            return _forest_margin_path(binned_b, sf, sb, lv, weights,
+                                       depth, kernel, block_rows) * mask
 
-        forest_forward._prewarm = ("forest_forward", {"depth": int(depth)})
-        _forest_forwards[depth] = fn = forest_forward
+        forest_forward._prewarm = ("forest_forward", {
+            "depth": int(depth), "kernel": str(kernel),
+            "block_rows": int(block_rows)})
+        _forest_forwards[key] = fn = forest_forward
     return fn
 
 
 _forest_programs: dict = {}
 
 
-def _forest_program(depth: int):
+def _forest_program(depth: int, kernel: str = "xla", block_rows: int = 0):
     mesh = meshlib.get_mesh()
-    key = (depth, id(mesh))
+    key = (depth, id(mesh), kernel, block_rows)
     if key not in _forest_programs:
         _forest_programs[key] = cached_data_parallel(
-            _make_forest_forward(depth), out_replicated=False,
-            replicated_argnums=(2, 3, 4, 5))
+            _make_forest_forward(depth, kernel, block_rows),
+            out_replicated=False, replicated_argnums=(2, 3, 4, 5))
     return _forest_programs[key]
 
 
 _forest_eval_fns: dict = {}
 
 
-def forest_eval_fn(depth: int, link: str = "identity"):
+def forest_eval_fn(depth: int, link: str = "identity",
+                   kernel: str = "xla", block_rows: int = 0):
     """Fused predict+metric program for the evaluator pushdown: traverse
     the stacked ensemble AND reduce the five regression sufficient
     statistics in one dispatch — D2H is five scalars instead of a
@@ -134,9 +313,11 @@ def forest_eval_fn(depth: int, link: str = "identity"):
     program (the ML 11 shape: fit on log(label), metric on
     exp(prediction) — `SML/ML 11 - XGBoost.py`'s log-price flow).
 
-    Module-level per-(depth, link) fn identity so cached_data_parallel's
-    program cache hits across calls."""
-    key = (depth, link)
+    Module-level per-(depth, link, kernel, block_rows) fn identity so
+    cached_data_parallel's program cache hits across calls — the
+    resolved traversal spec keys the executable exactly like the
+    forward program's."""
+    key = (depth, link, kernel, block_rows)
     fn = _forest_eval_fns.get(key)
     if fn is not None:
         return fn
@@ -145,7 +326,8 @@ def forest_eval_fn(depth: int, link: str = "identity"):
     link_fn = None if link == "identity" else getattr(jnp, link)
 
     def forest_eval(binned_b, l, lmask, mask, sf, sb, lv, weights, base):
-        pred = base + _forest_margin(binned_b, sf, sb, lv, weights, depth)
+        pred = base + _forest_margin_path(binned_b, sf, sb, lv, weights,
+                                          depth, kernel, block_rows)
         if link_fn is not None:
             pred = link_fn(pred)
             # the link can produce NaN/inf (log of a <=0 margin, exp
@@ -167,23 +349,64 @@ def forest_eval_fn(depth: int, link: str = "identity"):
         return n, se, ae, sl, sl2
 
     forest_eval.__name__ = f"forest_eval_d{depth}" + \
-        ("" if link == "identity" else f"_{link}")
-    forest_eval._prewarm = ("forest_eval", {"depth": int(depth),
-                                            "link": str(link)})
+        ("" if link == "identity" else f"_{link}") + \
+        ("" if kernel == "xla" else f"_{kernel}")
+    forest_eval._prewarm = ("forest_eval", {
+        "depth": int(depth), "link": str(link), "kernel": str(kernel),
+        "block_rows": int(block_rows)})
     _forest_eval_fns[key] = forest_eval
     return forest_eval
 
 
 def _register_prewarm_factories() -> None:
+    # meta.get defaults keep pre-tuner manifests replayable (entries
+    # recorded before the kernel/block_rows lanes existed are XLA specs)
     from ..parallel import prewarm as _prewarm
     _prewarm.register_fn_factory(
-        "forest_forward", lambda m: _make_forest_forward(int(m["depth"])))
+        "forest_forward",
+        lambda m: _make_forest_forward(int(m["depth"]),
+                                       str(m.get("kernel", "xla")),
+                                       int(m.get("block_rows", 0))))
     _prewarm.register_fn_factory(
-        "forest_eval", lambda m: forest_eval_fn(int(m["depth"]),
-                                                str(m["link"])))
+        "forest_eval",
+        lambda m: forest_eval_fn(int(m["depth"]), str(m["link"]),
+                                 str(m.get("kernel", "xla")),
+                                 int(m.get("block_rows", 0))))
+
+
+def _replay_infer_kernel(meta: dict) -> None:
+    """Prewarm rebuilder for autotuned traversal specs ("infer_kernel"
+    manifest entries): rebuild the forward program for the RECORDED
+    (model shape, batch width, spec) and first-dispatch it on
+    zero-filled operands — replica spin-up (`ServingEndpoint.__init__`'s
+    `maybe_prewarm`) lands on the tuned kernel already compiled, without
+    a sweep and without waiting for first traffic."""
+    from .tree_impl import bin_dtype
+    key, spec = meta["key"], meta["spec"]
+    depth = int(key["depth"])
+    T, F = int(key["trees"]), int(key["features"])
+    rows = int(key["rows"])
+    n_nodes = 2 ** (depth + 1) - 1
+    prog = _forest_program(depth, str(spec.get("kernel", "xla")),
+                           int(spec.get("block_rows", 0)))
+    mesh = meshlib.get_mesh()
+    Bd = jax.device_put(
+        np.zeros((rows, F), dtype=bin_dtype(int(key["bins"]))),
+        meshlib.data_sharding(mesh, 2))
+    mask = jax.device_put(np.zeros((rows,), np.float32),
+                          meshlib.data_sharding(mesh, 1))
+    jax.device_get(prog(
+        Bd, mask, jnp.asarray(np.full((T, n_nodes), -1, np.int32)),
+        jnp.asarray(np.zeros((T, n_nodes), np.int32)),
+        jnp.asarray(np.zeros((T, n_nodes), np.float32)),
+        jnp.asarray(np.zeros((T,), np.float32))))
 
 
 _register_prewarm_factories()
+
+from ..parallel import prewarm as _prewarm_mod
+
+_prewarm_mod.register_rebuilder("infer_kernel", _replay_infer_kernel)
 
 
 def _stage_rows(X: np.ndarray):
@@ -214,13 +437,25 @@ def predict_linear_sharded(X: np.ndarray, w: np.ndarray, b: float,
 def predict_forest_sharded(binned: np.ndarray, sf: np.ndarray,
                            sb: np.ndarray, lv: np.ndarray,
                            weights: np.ndarray, depth: int,
-                           base: float = 0.0) -> np.ndarray:
+                           base: float = 0.0,
+                           n_bins: Optional[int] = None) -> np.ndarray:
     """Stacked-ensemble traversal: rows sharded over the mesh, tree tensors
     replicated (they are KB-scale), one fused program for the whole forest.
     `binned` keeps its compact quantized dtype end-to-end (the program
-    widens on-device)."""
-    Bd, mask, n = _stage_rows(np.ascontiguousarray(binned))
-    prog = _forest_program(depth)
+    widens on-device). The traversal implementation (XLA where-sums vs
+    the fused `native/traverse_kernel.py` launch) resolves per dispatch
+    through `resolve_infer_kernel`; `n_bins` feeds the autotuned-spec
+    key (absent, the compact dtype's capacity stands in — same model,
+    same stand-in, so lookups stay consistent)."""
+    binned = np.ascontiguousarray(binned)
+    if n_bins is None:
+        n_bins = int(np.iinfo(binned.dtype).max) + 1 \
+            if binned.dtype.kind in "ui" else 0
+    kernel, block_rows, _ = resolve_infer_kernel(
+        n_trees=sf.shape[0], depth=depth, n_nodes=sf.shape[1],
+        n_feat=binned.shape[1], n_bins=n_bins, n_rows=binned.shape[0])
+    Bd, mask, n = _stage_rows(binned)
+    prog = _forest_program(depth, kernel, block_rows)
     out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
                jnp.asarray(lv, dtype=jnp.float32),
                jnp.asarray(weights, dtype=jnp.float32))
@@ -241,6 +476,10 @@ class DeviceScorer:
 
     def __init__(self, model):
         self._stages = []
+        #: last traversal spec this scorer's device route resolved
+        #: (None until a device-routed forest dispatch; linear models
+        #: never traverse) — surfaced by ServingEndpoint.health_report()
+        self._kernel_spec = None
         tail = model
         stages = getattr(model, "stages", None)
         if stages:
@@ -330,8 +569,15 @@ class DeviceScorer:
                 margin = predict_forest(binned, spec.trees, spec.depth,
                                         spec.tree_weights)
             return margin, n, finalize
-        Bd, mask, n = _stage_rows(np.ascontiguousarray(binned))
-        prog = _forest_program(spec.depth)
+        binned = np.ascontiguousarray(binned)
+        kernel, block_rows, tuned = resolve_infer_kernel(
+            n_trees=sf.shape[0], depth=spec.depth, n_nodes=sf.shape[1],
+            n_feat=binned.shape[1],
+            n_bins=spec.binning.edges.shape[1] + 1, n_rows=n)
+        self._kernel_spec = {"kernel": kernel, "block_rows": block_rows,
+                             "tuned": tuned}
+        Bd, mask, n = _stage_rows(binned)
+        prog = _forest_program(spec.depth, kernel, block_rows)
         out = prog(Bd, mask, jnp.asarray(sf), jnp.asarray(sb),
                    jnp.asarray(lv, dtype=jnp.float32),
                    jnp.asarray(w, dtype=jnp.float32))
@@ -373,6 +619,12 @@ class DeviceScorer:
                                     spec.tree_weights)
         return self._finalize_forest(margin)
 
+    def kernel_spec(self) -> Optional[dict]:
+        """The traversal spec this scorer's most recent device-routed
+        forest dispatch resolved to ({kernel, block_rows, tuned}), or
+        None (linear model / no device dispatch yet)."""
+        return None if self._kernel_spec is None else dict(self._kernel_spec)
+
     def resident_bytes(self) -> int:
         """Approximate bytes a WARM scorer pins per mesh (model tensors
         replicated into HBM plus their host mirrors) — the cost model the
@@ -411,7 +663,16 @@ class DeviceScorer:
         import pandas as pd
         from .featurizer import (_IndexSource, _NumericSource,
                                  extract_numeric_block)
-        scalars, embeds = self._factorized
+        # snapshot BOTH compiled layers: score_batches' factorized branch
+        # runs __call__ on lookahead threads, so a concurrent batch that
+        # lost a raw column may null self._factorized/_featurizer while
+        # this thread is mid-score. A torn read must land on the same
+        # KeyError fallback ladder the missing column itself takes — not
+        # surface as AttributeError(None) out of the stream
+        factorized, featurizer = self._factorized, self._featurizer
+        if factorized is None or featurizer is None:
+            raise KeyError("factorized scorer disabled concurrently")
+        scalars, embeds = factorized
         _, b, logistic = self._params
         n = len(pdf)
         drop = np.zeros(n, dtype=bool)
@@ -444,7 +705,7 @@ class DeviceScorer:
             contrib[oki] = table[idx[oki].astype(np.intp)]
             contrib[na] = np.nan  # NaN one-hot row → NaN prediction
             acc += contrib
-        if self._featurizer.handle_invalid == "error" \
+        if featurizer.handle_invalid == "error" \
                 and not np.isfinite(acc[~drop]).all():
             raise ValueError(
                 "VectorAssembler found NaN/null in assembled features; set "
@@ -474,9 +735,10 @@ class DeviceScorer:
     def _prep(self, pdf) -> np.ndarray:
         if isinstance(pdf, np.ndarray):
             return pdf
-        if self._featurizer is not None:
+        featurizer = self._featurizer  # snapshot: concurrent batches may
+        if featurizer is not None:     # null it between check and call
             try:
-                return self._featurizer(pdf)
+                return featurizer(pdf)
             except KeyError:
                 # a column the compiled chain assumed raw isn't in this
                 # batch: permanently fall back to the generic stage path

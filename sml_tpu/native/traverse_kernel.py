@@ -1,0 +1,185 @@
+"""Pallas fused batched tree-traversal kernel for the serving hot path.
+
+PR 9 fused the *fit* hot path; scoring still rode the XLA ensemble
+traversal in `ml/inference.py` (`_forest_margin`): per level, the
+per-node one-hot, the feature-select one-hot, and the `(T, rows)`
+per-tree margin stack are all separate HLOs whose intermediates
+round-trip HBM between levels. This kernel fuses the whole descent
+ON-CHIP — the accelerator-side batched traversal of "Booster: An
+Accelerator for Gradient Boosting Decision Trees" (arXiv:2011.02022),
+with the batched node layout of "GPU-acceleration for Large-scale Tree
+Boosting" (arXiv:1706.08359) — for a block of rows at a time:
+
+- The ensemble rides as a level-order **SoA node table**: one lane per
+  node attribute — feature id (`sf`, −1 at leaves), split bin (`sb`),
+  leaf/node value (`lv`) — stacked `(T, n_nodes)` per tree, exactly the
+  heap layout `_EnsembleSpec.stacked()` already produces (children of
+  node *i* at 2i+1 / 2i+2, so descent needs no child-pointer gathers).
+  The tables are KB-scale and stay resident in VMEM for every grid step.
+- Rows stream HBM→VMEM in blocks; the **depth-unrolled predicated
+  descent** (the per-level node one-hot, the feature-select against the
+  compact bin matrix, the child step) and the per-tree **leaf sums
+  accumulate in-register** — only the final `(block,)` weighted margin
+  leaves the kernel. The per-level one-hots and the `(T, rows)` margin
+  stack never touch HBM.
+
+The kernel body is op-for-op `ml/inference._forest_margin`'s math (same
+one-hot where-sums — gather-free and exact in f32, see that docstring
+for why — same select, same reductions). The traversal has NO cross-row
+operation, so row blocking cannot change any output bit: interpret mode
+(non-TPU backends, single block) and compiled mode (row-block grid) are
+both BIT-IDENTICAL to the XLA path, which tests/test_traverse_kernel.py
+asserts across DT/RF/xgboost, uint8/uint16 bin matrices, NaN rows, and
+the logistic finalize.
+
+Every `pl.pallas_call` in the package must live in `sml_tpu/native/`,
+and every *invocation* of `forest_traverse` must come from the
+`score_block` dispatch glue (`ml/inference.py`) — graftlint's
+`dispatch-bypass` rule flags both, so the `infer.kernel.*` counters and
+the fallback ladder stay authoritative.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.profiler import PROFILER
+from .hist_kernel import _tpu_compiler_params, available  # noqa: F401
+
+#: compiled-path VMEM budget per grid step (the per-level one-hot tiles
+#: plus the resident node tables; ~16 MB VMEM/core shared with operands)
+TRAVERSE_VMEM_BUDGET = 8 << 20
+
+
+def traverse_vmem_bytes(block_rows: int, n_trees: int, n_nodes: int,
+                        n_feat: int) -> int:
+    """Per-grid-step VMEM estimate of the compiled traversal: the f32
+    per-level node one-hot and leaf one-hot tiles (`block·n_nodes` each),
+    the feature-select tile (`block·F`), the in-register per-tree margin
+    stack (`T·block`), the widened bin tile (`block·F`), and the resident
+    SoA node tables (three `(T, n_nodes)` lanes). The guard in
+    `ml/inference.py` demotes oversized (block_rows × trees) specs with
+    this estimate instead of failing to lower mid-trace (block_rows=0 =
+    the block-independent node-table term alone)."""
+    blk = max(int(block_rows), 0)
+    return int(4 * blk * (2 * n_nodes + 2 * n_feat + n_trees)
+               + 12 * n_trees * n_nodes)
+
+
+def max_block_rows(n_trees: int, n_nodes: int, n_feat: int) -> int:
+    """Largest row block whose per-grid-step estimate fits
+    `TRAVERSE_VMEM_BUDGET`, or 0 when even a minimal 8-row block cannot
+    (the resident node tables alone bust the budget — the spec must
+    demote to XLA). THE single source of the guard's arithmetic: the
+    resolver in `ml/inference.py` clamps/demotes through this, so the
+    budget math cannot drift from the `traverse_vmem_bytes` estimate."""
+    fixed = traverse_vmem_bytes(0, n_trees, n_nodes, n_feat)
+    per_row = traverse_vmem_bytes(1, n_trees, n_nodes, n_feat) - fixed
+    blk = (TRAVERSE_VMEM_BUDGET - fixed) // max(per_row, 1)
+    return int(blk) if blk >= 8 else 0
+
+
+def _block_plan(n: int, interpret: bool,
+                block_rows: Optional[int]) -> Tuple[int, int]:
+    """(grid steps, rows per block). Interpret mode uses ONE block (no
+    VMEM to bound; fewer traced ops). Compiled mode picks the largest
+    divisor of `n` at or under the target so every grid step sees a full
+    block — rows are bucket-padded by staging, so divisors are dense.
+    Unlike the fit kernel's plan this never changes results: the
+    traversal has no cross-row reduction, so blocking is pure VMEM
+    scheduling."""
+    if interpret:
+        return 1, n
+    if block_rows is None:
+        from ..conf import GLOBAL_CONF
+        block_rows = GLOBAL_CONF.getInt("sml.infer.kernelBlockRows")
+    target = max(1, min(int(block_rows), n))
+    k = -(-n // target)
+    while n % k:
+        k += 1
+    return k, n // k
+
+
+def forest_traverse(binned, sf, sb, lv, weights, *, depth: int,
+                    interpret: bool = False,
+                    block_rows: Optional[int] = None):
+    """Weighted stacked-ensemble margin for a per-chip row block, fused
+    in one kernel launch: `(rows,)` f32 from the compact bin matrix.
+
+    `binned` is the bin-cache operand as staged (uint8/uint16 — or int32
+    on wide-bin models); `sf`/`sb`/`lv` are the level-order SoA node
+    tables (`(T, n_nodes)`, `_EnsembleSpec.stacked()` layout) and
+    `weights` the `(T,)` per-tree weights. Equivalent XLA-path
+    computation, which the kernel body reproduces op-for-op per block:
+    `ml/inference._forest_margin(binned, sf, sb, lv, weights, depth)`.
+
+    The mask multiply, the base offset, and every psum of the fused
+    eval program stay OUTSIDE the kernel in the `ml/inference.py` glue,
+    so the kernel swap cannot change semantics — only where the per-level
+    intermediates live."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n, F = binned.shape
+    T, n_nodes = sf.shape
+    nblk, blk = _block_plan(n, interpret, block_rows)
+
+    def kernel(b_ref, sf_ref, sb_ref, lv_ref, w_ref, out_ref):
+        # the XLA path's exact ops on one row block (_forest_margin):
+        # one-hot masked where-SUMs, exact in f32 — no gathers, no MXU
+        # bf16 operand truncation
+        binned_f = b_ref[...].astype(jnp.float32)
+        fio = jnp.arange(F, dtype=jnp.float32)
+
+        def one_tree(f, s, v):
+            fpos = jnp.maximum(f, 0).astype(jnp.float32)
+            internal = f >= 0
+            s_f = s.astype(jnp.float32)
+            node = jnp.zeros((blk,), dtype=jnp.int32)
+            for lvl in range(depth):
+                width = min(2 ** (lvl + 1) - 1, n_nodes)
+                iota = jnp.arange(width, dtype=jnp.int32)
+                oh = node[:, None] == iota[None, :]
+                fa = jnp.sum(jnp.where(oh, fpos[None, :width], 0.0), axis=1)
+                ba = jnp.sum(jnp.where(oh, s_f[None, :width], 0.0), axis=1)
+                isin = jnp.any(oh & internal[None, :width], axis=1)
+                xbin = jnp.sum(jnp.where(fio[None, :] == fa[:, None],
+                                         binned_f, 0.0), axis=1)
+                child = 2 * node + 1 + (xbin > ba).astype(jnp.int32)
+                node = jnp.where(isin, child, node)
+            leaf_oh = (node[:, None]
+                       == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+            return jnp.sum(jnp.where(leaf_oh,
+                                     v.astype(jnp.float32)[None, :], 0.0),
+                           axis=1)
+
+        per_tree = jax.vmap(one_tree)(sf_ref[...], sb_ref[...], lv_ref[...])
+        out_ref[...] = jnp.sum(
+            w_ref[...].astype(jnp.float32)[:, None] * per_tree, axis=0)
+
+    kwargs = {}
+    if not interpret:
+        params = _tpu_compiler_params()
+        if params is not None:
+            kwargs["compiler_params"] = params
+    PROFILER.count("kernel.pallas_launch")
+    if interpret:
+        PROFILER.count("kernel.interpret")
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((blk, F), lambda i: (i, 0)),
+            pl.BlockSpec((T, n_nodes), lambda i: (0, 0)),
+            pl.BlockSpec((T, n_nodes), lambda i: (0, 0)),
+            pl.BlockSpec((T, n_nodes), lambda i: (0, 0)),
+            pl.BlockSpec((T,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((blk,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )(binned, sf, sb, lv, weights)

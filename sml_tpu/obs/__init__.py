@@ -207,6 +207,12 @@ def slo_report(window_s: Optional[float] = None) -> Dict[str, float]:
             "worst_trace": _context.hex_id(worst_trace)}
 
 
+def _infer_kernel_report() -> Optional[Dict[str, object]]:
+    import sys
+    mod = sys.modules.get("sml_tpu.ml.inference")
+    return None if mod is None else mod.kernel_report()
+
+
 def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
     """ONE call, the engine's whole health surface: streaming-metric
     quantiles (serving latency, per-route dispatch walls), the dispatch
@@ -244,6 +250,12 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         # refit-trigger verdicts next to the `ingest` skew block above).
         # None until a monitor registers (a model carrying a baseline)
         "drift": drift.DRIFT.report(),
+        # scoring traversal-kernel resolution (ml/inference.py): the
+        # last resolved spec (kernel / block_rows / tuned provenance)
+        # and cumulative fallback+demotion counts. Read lazily off
+        # sys.modules so a health poll never drags jax in — None until
+        # the inference module has loaded (nothing scored yet)
+        "infer_kernel": _infer_kernel_report(),
     }
     if RECORDER.enabled:
         RECORDER.emit("health", "health.snapshot", args={

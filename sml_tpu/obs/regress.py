@@ -106,6 +106,7 @@ def normalize(doc: dict) -> dict:
                         if isinstance(v, (int, float))},
             "multichip": doc.get("multichip"),
             "kernel": doc.get("kernel"),
+            "kernel_infer": doc.get("kernel_infer"),
             "scale": doc.get("scale"),
             "drift": doc.get("drift"),
             "shape": "sidecar",
@@ -132,6 +133,7 @@ def normalize(doc: dict) -> dict:
         "metrics": metrics,
         "multichip": mc,
         "kernel": doc.get("kernel"),
+        "kernel_infer": doc.get("kernel_infer"),
         "scale": doc.get("scale"),
         "drift": doc.get("drift"),
         "shape": "record",
@@ -332,6 +334,50 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                     reg.append(_finding("kernel-wall", f"{tag}:{key}",
                                         float(bv), float(cv), tol,
                                         "regression"))
+
+    # ---- kernelbench inference sweep (autotuned traversal specs)
+    bki, cki = base.get("kernel_infer"), cand.get("kernel_infer")
+    if bki and not cki and cand.get("shape") != "record":
+        # coverage rule, like the fit-kernel block: bench.py carries the
+        # block across plain suite runs, so a sidecar candidate missing
+        # it actually lost the autotuner gate; driver records exempt
+        reg.append(_finding(
+            "missing-kernel-infer-block", "kernel_infer", 1.0, 0.0, 0.0,
+            "regression",
+            "kernelbench inference block present in base, absent in "
+            "candidate"))
+    if cki:
+        # a NONZERO fallback count is a regression in its own right:
+        # scoring dispatches requested (or were tuned to) pallas but
+        # silently degraded to XLA — judged against the base's count so
+        # an intentionally committed nonzero baseline stays comparable
+        bf = float((bki or {}).get("fallbacks", 0.0))
+        cf = float(cki.get("fallbacks", 0.0))
+        checked += 1
+        if cf > bf:
+            reg.append(_finding(
+                "infer-kernel-fallback", "fallbacks", bf, cf, 0.0,
+                "regression",
+                "infer.kernel.fallback grew — scoring silently off the "
+                "tuned/pallas path"))
+    if bki and cki:
+        proofs = [("replay_ok",
+                   "tuned spec no longer round-trips through the prewarm "
+                   "manifest (replay would re-sweep)")]
+        # beats-default is only a PROOF on compiled runs: in interpret
+        # mode every pallas block_rows candidate executes the identical
+        # single-block program, so the margin is timer noise — judging
+        # it would flip the gate on an honest CPU re-run
+        if not (bki.get("interpret") or cki.get("interpret")):
+            proofs.append(("tuned_beats_default",
+                           "autotuned spec no longer beats the default "
+                           "kernelBlockRows at any sweep point"))
+        for key, note in proofs:
+            if bki.get(key) and cki.get(key) is not True:
+                checked += 1
+                reg.append(_finding(
+                    "infer-kernel-proof", key, 1.0, 0.0, 0.0,
+                    "regression", note))
 
     # ---- out-of-core scale block (data-plane throughput + coverage)
     bsc, csc = base.get("scale"), cand.get("scale")

@@ -60,6 +60,14 @@ COUNTERS = {
     # that requested pallas but degraded to the XLA path — bench_diff
     # treats any growth as a regression
     "kernel.*",
+    # fused traversal kernel on the SCORING path (native/traverse_kernel
+    # + ml/inference.py resolution): infer.kernel.pallas / infer.kernel.xla
+    # count spec resolutions landing on each path; infer.kernel.fallback
+    # counts dispatches that requested (or were tuned to) pallas but
+    # demoted to XLA — obs/regress.py flags any growth, like
+    # kernel.fallback; infer.kernel.autotune_s accumulates --kernelbench
+    # sweep seconds (the cost the persisted manifest spec amortizes away)
+    "infer.kernel.*",
     "compile.programs",
     "compile.program.*",  # per-name program-cache-miss counts (bench
                           # derives distinct-programs-per-leg from these)
@@ -117,6 +125,9 @@ EVENTS = {
     "compile.*",          # compile.trace / compile.cache_dir
     "serve.*",            # serve.swap (endpoint hot-swap receipts)
     "infer.*",            # infer.dispatch / infer.drain (batch pipelining)
+                          # + infer.kernel.spec (a scoring dispatch's
+                          # resolved traversal spec CHANGED: kernel,
+                          # block_rows, tuned-or-conf provenance)
     "ingest.*",           # ingest.dispatch / ingest.drain (chunk-i+1
                           # H2D overlapping chunk-i device work — the
                           # double-buffered prefetch proof) + ingest.note

@@ -296,6 +296,7 @@ class ServingEndpoint:
         so a liveness probe can poll it."""
         from .. import obs
         health = obs.engine_health(window_s)
+        scorer = self._scorer
         health["endpoint"] = {
             "name": self._name,
             "stage": self._stage,
@@ -305,6 +306,12 @@ class ServingEndpoint:
             "max_batch_rows": self._batcher.max_batch_rows,
             "closed": self._closed,
             "canary": self.canary_stats(),
+            # THIS replica's resolved traversal spec (None until a
+            # device-routed forest dispatch) — next to the engine-wide
+            # `infer_kernel` block, so a replica silently off the
+            # autotuned kernel is attributable to the endpoint
+            "kernel": (scorer.kernel_spec()
+                       if hasattr(scorer, "kernel_spec") else None),
         }
         return health
 

@@ -142,6 +142,44 @@ def test_bypass_allows_pallas_call_in_native_dir(lint):
     assert rules_fired(rep2) == BYPASS
 
 
+def test_bypass_flags_traverse_kernel_outside_dispatch_glue(lint):
+    """Direct invocation of the traversal kernel entry outside the
+    score_block dispatch glue skips resolve_infer_kernel (VMEM guard,
+    tuned specs, infer.kernel.* counters) — flagged like a raw
+    pallas_call, in both the attribute and bare-name spellings."""
+    rep = run_on(lint, {"sml_tpu/serving/rogue_traverse.py": (
+        "def score(binned, sf, sb, lv, w):\n"
+        "    return _tk.forest_traverse(binned, sf, sb, lv, w, depth=4)\n")},
+        rules=BYPASS)
+    assert rules_fired(rep) == BYPASS
+    assert "forest_traverse" in rep.violations[0].message
+    assert "score_block" in rep.violations[0].message
+    rep2 = run_on(lint, {"sml_tpu/ml/rogue2.py": (
+        "out = forest_traverse(b, sf, sb, lv, w, depth=4)\n")},
+        rules=BYPASS)
+    assert rules_fired(rep2) == BYPASS
+
+
+def test_bypass_allows_traverse_kernel_in_sanctioned_glue(lint):
+    """`ml/inference.py`'s `_forest_margin_path` is the one sanctioned
+    invocation site (everything reaching it went through
+    resolve_infer_kernel); native/ may compose its own entries. Any
+    OTHER function in inference.py calling the kernel still flags."""
+    rep = run_on(lint, {"sml_tpu/ml/inference.py": (
+        "def _forest_margin_path(b, sf, sb, lv, w, depth, kernel, rows):\n"
+        "    return _tk.forest_traverse(b, sf, sb, lv, w, depth=depth)\n"),
+        "sml_tpu/native/traverse_kernel.py": (
+        "def probe():\n"
+        "    return forest_traverse(b, sf, sb, lv, w, depth=1)\n")},
+        rules=BYPASS)
+    assert rep.clean
+    rep2 = run_on(lint, {"sml_tpu/ml/inference.py": (
+        "def _dispatch(self, X):\n"
+        "    return _tk.forest_traverse(X, sf, sb, lv, w, depth=4)\n")},
+        rules=BYPASS)
+    assert rules_fired(rep2) == BYPASS
+
+
 # --------------------------------------------------- rule 3: conf-key-registry
 CONF = ["conf-key-registry"]
 _REGISTRY = ("def _register(k, d, c, doc=''):\n    pass\n"

@@ -112,6 +112,59 @@ def test_prefetch_depth_configurable_and_overlap(fitted_lr):
                                rtol=1e-6)
 
 
+def test_factorized_fallback_on_missing_column_mid_stream(fitted_lr):
+    """A batch missing a raw column mid-stream kills BOTH compiled
+    layers permanently (`__call__`'s KeyError → `self._factorized =
+    None`, then `_prep`'s KeyError → `self._featurizer = None`): the bad
+    batch itself raises (no layer can conjure the column), but every
+    later complete batch still scores correctly through the generic
+    stage path — and score_batches switches from the factorized host map
+    to the dispatch pipeline."""
+    pipe, df = fitted_lr
+    scorer = DeviceScorer(pipe)
+    assert scorer._factorized is not None and scorer._featurizer is not None
+    pdf = df.toPandas()
+    expected = scorer(pdf)
+    bad = pdf.drop(columns=["bathrooms"])
+    batches = [pdf.iloc[:500], bad, pdf.iloc[500:1000]]
+    it = scorer.score_batches(iter(batches))
+    np.testing.assert_allclose(next(it), expected[:500], rtol=1e-5)
+    with pytest.raises(KeyError, match="bathrooms"):
+        for _ in it:
+            pass
+    # the fallback is PERMANENT, not per-batch retried
+    assert scorer._factorized is None and scorer._featurizer is None
+    # a fresh stream of complete batches scores through the generic
+    # stage path (prefetch_pipeline now — factorized is gone) and
+    # matches the factorized results
+    outs = list(scorer.score_batches([pdf.iloc[i:i + 500]
+                                      for i in range(0, len(pdf), 500)]))
+    np.testing.assert_allclose(np.concatenate(outs), expected, rtol=1e-5)
+
+
+def test_prep_featurizer_keyerror_falls_back_to_stages(spark, airbnb_pdf):
+    """`_prep`'s compiled-featurizer KeyError fallback, isolated from the
+    factorized-linear layer: a forest pipeline has a featurizer but no
+    factorized scorer, so the missing-column batch exercises exactly the
+    `self._featurizer = None` branch; later batches ride the generic
+    stage path with identical predictions."""
+    df = spark.createDataFrame(airbnb_pdf)
+    va = VectorAssembler(inputCols=["bedrooms", "accommodates",
+                                    "bathrooms"], outputCol="features")
+    rf = RandomForestRegressor(featuresCol="features", labelCol="price",
+                               numTrees=4, maxDepth=3, seed=1)
+    pipe = Pipeline(stages=[va, rf]).fit(df)
+    scorer = DeviceScorer(pipe)
+    assert scorer._factorized is None and scorer._featurizer is not None
+    pdf = df.toPandas()
+    expected = scorer(pdf)
+    with pytest.raises(KeyError, match="accommodates"):
+        scorer(pdf.drop(columns=["accommodates"]))
+    assert scorer._featurizer is None  # permanent generic-stage fallback
+    got = scorer(pdf)
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
 def test_sharded_predict_large_batch_matches_small(fitted_lr):
     """The >=4096-row sharded path and the single-device path must agree."""
     pipe, _ = fitted_lr

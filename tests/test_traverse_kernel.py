@@ -1,0 +1,356 @@
+"""Pallas fused tree-traversal inference kernel + autotuner (ISSUE 12).
+
+The contract (docs/KERNELS.md): with `sml.infer.kernel=pallas` on a
+non-TPU backend the traversal kernel runs in INTERPRET mode, op-for-op
+`_forest_margin`'s math — kernel-path predictions must be BIT-IDENTICAL
+to the XLA traversal for DT/RF/boosted ensembles across bin dtypes, NaN
+rows, and the logistic finalize; 'auto' never emulates on CPU; the
+resolved (kernel, block_rows) spec keys the program cache; autotuned
+specs round-trip through the prewarm manifest; the VMEM guard demotes
+oversized (block_rows × trees) specs on real TPU; and the fallback /
+spec surface shows in `engine_health()["infer_kernel"]` and the
+`obs/regress.py` kernel_infer rules.
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from sml_tpu.conf import GLOBAL_CONF
+from sml_tpu.utils.profiler import PROFILER
+
+
+@pytest.fixture()
+def infer_conf():
+    """Restore scoring-kernel knobs after each test."""
+    keys = ("sml.infer.kernel", "sml.infer.kernelBlockRows",
+            "sml.infer.autotune", "sml.profiler.enabled",
+            "sml.dispatch.mode")
+    prev = {k: GLOBAL_CONF.get(k) for k in keys}
+    GLOBAL_CONF.set("sml.profiler.enabled", True)
+    GLOBAL_CONF.set("sml.infer.autotune", False)
+    yield
+    for k, v in prev.items():
+        GLOBAL_CONF.set(k, v)
+
+
+def _toy(n=5000, f=8, seed=0, nan_rows=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    if nan_rows:
+        X[::17, 2] = np.nan  # binned like any other value by bin_with
+    y = (2 * X[:, 0] - np.nan_to_num(X[:, 1]) ** 2
+         + rng.normal(0, 0.3, n)).astype(np.float32)
+    return X.astype(np.float32), y
+
+
+def _fit_kind(kind, X, y, max_bins):
+    from sml_tpu.ml._tree_models import _fit_ensemble
+    common = dict(categorical={}, max_bins=max_bins, min_instances=1,
+                  min_info_gain=0.0, seed=7)
+    if kind == "dt":
+        return _fit_ensemble(X, y, max_depth=5, n_trees=1, feature_k=None,
+                             bootstrap=False, subsample=1.0,
+                             loss="squared", **common)
+    if kind == "rf":
+        return _fit_ensemble(X, y, max_depth=4, n_trees=6, feature_k=3,
+                             bootstrap=True, subsample=1.0,
+                             loss="squared", **common)
+    if kind == "xgb":
+        return _fit_ensemble(X, y, max_depth=4, n_trees=5, feature_k=None,
+                             bootstrap=False, subsample=1.0,
+                             loss="squared", boosting=True,
+                             reg_lambda=1.0, **common)
+    raise AssertionError(kind)
+
+
+def _margins(spec, binned, kernel):
+    from sml_tpu.ml import inference
+    GLOBAL_CONF.set("sml.infer.kernel", kernel)
+    sf, sb, lv, w = spec.stacked()
+    return inference.predict_forest_sharded(
+        binned, sf, sb, lv, w, spec.depth, base=spec.base,
+        n_bins=spec.binning.edges.shape[1] + 1)
+
+
+# ------------------------------------------------------------ bit parity
+@pytest.mark.parametrize("kind", ["dt", "rf", "xgb"])
+@pytest.mark.parametrize("max_bins", [32, 300])  # uint8 / uint16 operands
+def test_margin_parity_bitwise_vs_xla(spark, infer_conf, kind, max_bins):
+    """Kernel-path margins == XLA-path margins, bit for bit, for every
+    ensemble kind, both compact bin dtypes, NaN rows included."""
+    from sml_tpu.ml import tree_impl
+    X, y = _toy()
+    spec = _fit_kind(kind, X, y, max_bins)
+    binned = tree_impl.bin_with(np.asarray(X, np.float64), spec.binning)
+    assert binned.dtype == (np.uint8 if max_bins <= 256 else np.uint16)
+    m_xla = _margins(spec, binned, "xla")
+    m_pal = _margins(spec, binned, "pallas")
+    np.testing.assert_array_equal(m_xla, m_pal)
+
+
+def test_logistic_finalize_parity_through_scorer(spark, infer_conf):
+    """DeviceScorer.score_block on a boosted BINARY model: the sigmoid
+    finalize sits on top of bit-identical margins, so kernel-path
+    probabilities equal the XLA path's exactly. The scorer's resolved
+    spec is surfaced by kernel_spec()."""
+    from sml_tpu.ml.inference import DeviceScorer
+    X, y = _toy()
+    yb = (y > np.median(y)).astype(np.float32)
+    spec = _fit_kind("xgb", X, yb, 32)
+    spec_l = spec  # squared-boosted; refit logistic for the sigmoid path
+    from sml_tpu.ml._tree_models import _fit_ensemble
+    spec_l = _fit_ensemble(X, yb, categorical={}, max_depth=4, max_bins=32,
+                           min_instances=1, min_info_gain=0.0, n_trees=5,
+                           feature_k=None, bootstrap=False, subsample=1.0,
+                           seed=7, loss="logistic", boosting=True)
+    assert spec_l.mode == "binary"
+    scorer = DeviceScorer(types.SimpleNamespace(_spec=spec_l))
+    GLOBAL_CONF.set("sml.dispatch.mode", "device")  # pin the kernel route
+    GLOBAL_CONF.set("sml.infer.kernel", "xla")
+    p_xla = scorer.score_block(X)
+    GLOBAL_CONF.set("sml.infer.kernel", "pallas")
+    p_pal = scorer.score_block(X)
+    np.testing.assert_array_equal(p_xla, p_pal)
+    assert np.all((p_pal >= 0.0) & (p_pal <= 1.0))
+    ks = scorer.kernel_spec()
+    assert ks is not None and ks["kernel"] == "pallas"
+
+
+def test_forest_eval_parity_bitwise(spark, infer_conf):
+    """The fused predict+metric eval program under the kernel path:
+    bit-identical margins feed the same psums, so all five sufficient
+    statistics are exactly equal."""
+    from sml_tpu.ml import tree_impl
+    from sml_tpu.ml._staging import run_data_parallel
+    from sml_tpu.ml.inference import forest_eval_fn
+    X, y = _toy()
+    spec = _fit_kind("rf", X, y, 32)
+    binned = tree_impl.bin_with(np.asarray(X, np.float64), spec.binning)
+    sf, sb, lv, w = spec.stacked()
+    l32 = np.nan_to_num(y).astype(np.float32)
+    f32 = np.isfinite(y).astype(np.float32)
+    rep = (np.asarray(sf), np.asarray(sb),
+           np.asarray(lv, dtype=np.float32),
+           np.asarray(w, dtype=np.float32), np.float32(spec.base))
+    stats_x = run_data_parallel(forest_eval_fn(spec.depth, "identity"),
+                                binned, l32, f32, replicated=rep)
+    stats_p = run_data_parallel(
+        forest_eval_fn(spec.depth, "identity", "pallas", 2048),
+        binned, l32, f32, replicated=rep)
+    for a, b in zip(stats_x, stats_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- counters & health
+def test_kernel_counters_report_and_health(spark, infer_conf):
+    """The kernel path traces pallas launches (interpret on CPU), the
+    module report carries the resolved spec, and engine_health()
+    surfaces it as the infer_kernel block."""
+    import sml_tpu.obs as obs
+    from sml_tpu.ml import inference, tree_impl
+    X, y = _toy(n=3000)
+    spec = _fit_kind("rf", X, y, 32)
+    binned = tree_impl.bin_with(np.asarray(X, np.float64), spec.binning)
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    obs.reset()
+    try:
+        _margins(spec, binned, "xla")   # guarantee a spec TRANSITION so
+        _margins(spec, binned, "pallas")  # the change event fires below
+        c = obs.RECORDER.counters()
+        assert c.get("kernel.pallas_launch", 0.0) > 0
+        assert c.get("kernel.interpret", 0.0) > 0  # CPU = interpret mode
+        assert c.get("infer.kernel.pallas", 0.0) >= 1
+        rep = inference.kernel_report()
+        assert rep["kernel"] == "pallas" and rep["block_rows"] > 0
+        health = obs.engine_health()
+        assert health["infer_kernel"]["kernel"] == "pallas"
+        assert health["infer_kernel"]["fallbacks"] == rep["fallbacks"]
+        events = [e for e in obs.RECORDER.events()
+                  if e.name == "infer.kernel.spec"]
+        assert events and events[-1].args["kernel"] == "pallas"
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", prev_obs)
+
+
+def test_auto_never_selects_pallas_on_cpu(spark, infer_conf):
+    """'auto' = pallas on real TPU only; CPU emulation is an explicit
+    opt-in, and landing on xla via auto is NOT a fallback."""
+    from sml_tpu.ml import inference
+    GLOBAL_CONF.set("sml.infer.kernel", "auto")
+    f0 = inference._KERNEL_STATE["fallbacks"]
+    k, br, tuned = inference.resolve_infer_kernel(
+        n_trees=5, depth=4, n_nodes=31, n_feat=8, n_bins=32, n_rows=4096)
+    assert (k, br, tuned) == ("xla", 0, False)
+    assert inference._KERNEL_STATE["fallbacks"] == f0
+    GLOBAL_CONF.set("sml.infer.kernel", "bogus")
+    with pytest.raises(ValueError, match="sml.infer.kernel"):
+        inference.resolve_infer_kernel(
+            n_trees=5, depth=4, n_nodes=31, n_feat=8, n_bins=32,
+            n_rows=4096)
+
+
+def test_fallback_when_kernel_unavailable(spark, infer_conf, monkeypatch):
+    """Requested pallas with a dead toolchain: the resolver lands on xla
+    and counts infer.kernel.fallback — scoring never crashes."""
+    from sml_tpu.ml import inference, tree_impl
+    from sml_tpu.native import hist_kernel
+    monkeypatch.setitem(hist_kernel._avail, "ok", False)
+    GLOBAL_CONF.set("sml.infer.kernel", "pallas")
+    f0 = inference._KERNEL_STATE["fallbacks"]
+    k, br, _ = inference.resolve_infer_kernel(
+        n_trees=5, depth=4, n_nodes=31, n_feat=8, n_bins=32, n_rows=4096)
+    assert (k, br) == ("xla", 0)
+    assert inference._KERNEL_STATE["fallbacks"] == f0 + 1
+    X, y = _toy(n=2000)
+    spec = _fit_kind("dt", X, y, 32)
+    binned = tree_impl.bin_with(np.asarray(X, np.float64), spec.binning)
+    m = _margins(spec, binned, "pallas")  # scores via the xla fallback
+    GLOBAL_CONF.set("sml.infer.kernel", "xla")
+    np.testing.assert_array_equal(m, _margins(spec, binned, "xla"))
+
+
+def test_vmem_guard_demotes_oversized_specs_on_tpu(spark, infer_conf):
+    """On (simulated) real TPU the resolver clamps block_rows to the
+    traversal VMEM budget, and a spec whose resident node tables alone
+    bust it demotes to xla with fallback + demotion counts; CPU
+    interpret mode never clamps or demotes."""
+    from sml_tpu.ml import inference, tree_impl
+    from sml_tpu.parallel import mesh as meshlib
+    GLOBAL_CONF.set("sml.infer.kernel", "pallas")
+    GLOBAL_CONF.set("sml.infer.kernelBlockRows", 10 ** 6)
+    k, br, _ = inference.resolve_infer_kernel(
+        n_trees=8, depth=5, n_nodes=63, n_feat=10, n_bins=32,
+        n_rows=4096)
+    assert (k, br) == ("pallas", 10 ** 6)  # CPU: conf taken verbatim
+    mesh = meshlib.get_mesh()
+    tree_impl._platform_memo[id(mesh)] = (mesh, "tpu")  # simulate TPU
+    try:
+        k, br, _ = inference.resolve_infer_kernel(
+            n_trees=8, depth=5, n_nodes=63, n_feat=10, n_bins=32,
+            n_rows=4096)
+        assert k == "pallas" and 8 <= br < 10 ** 6  # clamped to budget
+        from sml_tpu.native import traverse_kernel as _tk
+        assert br == _tk.max_block_rows(8, 63, 10)  # ONE arithmetic
+        f0 = inference._KERNEL_STATE["fallbacks"]
+        d0 = inference._KERNEL_STATE["demotions"]
+        k, br, _ = inference.resolve_infer_kernel(
+            n_trees=2000, depth=10, n_nodes=2047, n_feat=10, n_bins=32,
+            n_rows=4096)  # 2000×2047 node tables >> the VMEM budget
+        assert (k, br) == ("xla", 0)
+        assert inference._KERNEL_STATE["fallbacks"] == f0 + 1
+        assert inference._KERNEL_STATE["demotions"] == d0 + 1
+    finally:
+        tree_impl._platform_memo.clear()
+
+
+# ------------------------------------------------- autotuned spec roundtrip
+def test_tuned_spec_roundtrip_through_prewarm_manifest(spark, infer_conf,
+                                                       tmp_path):
+    """record_tuned → manifest entry → resolver picks the tuned spec
+    (overriding conf) without a sweep; re-tuning REPLACES the entry; a
+    different batch width misses; the infer_kernel rebuilder replays the
+    recorded program into the live caches."""
+    from sml_tpu.ml import inference
+    from sml_tpu.parallel import mesh as meshlib, prewarm
+    prev_dir = GLOBAL_CONF.get("sml.compile.cacheDir")
+    GLOBAL_CONF.set("sml.compile.cacheDir", str(tmp_path))
+    try:
+        GLOBAL_CONF.set("sml.infer.autotune", True)
+        GLOBAL_CONF.set("sml.infer.kernel", "xla")  # tuned spec must win
+        key = inference.infer_spec_key(5, 4, 10, 32, 4096)
+        assert prewarm.tuned_spec("infer_kernel", key) is None
+        prewarm.record_tuned("infer_kernel", key,
+                             {"kernel": "pallas", "block_rows": 512})
+        assert prewarm.tuned_spec("infer_kernel", key) \
+            == {"kernel": "pallas", "block_rows": 512}
+        k, br, tuned = inference.resolve_infer_kernel(
+            n_trees=5, depth=4, n_nodes=31, n_feat=10, n_bins=32,
+            n_rows=4096)
+        assert (k, br, tuned) == ("pallas", 512, True)
+        assert inference.kernel_report()["tuned"] is True
+        # re-tune REPLACES (stable manifest key), never accumulates
+        prewarm.record_tuned("infer_kernel", key,
+                             {"kernel": "xla", "block_rows": 0})
+        assert prewarm.tuned_spec("infer_kernel", key) \
+            == {"kernel": "xla", "block_rows": 0}
+        mpath = os.path.join(str(tmp_path), "prewarm_manifest.json")
+        with open(mpath) as f:
+            entries = json.load(f)["entries"]
+        tuned = [e for e in entries.values()
+                 if e["kind"] == "infer_kernel"]
+        assert len(tuned) == 1
+        # a different batch width is a different key: conf path resolves
+        k2, br2, tuned2 = inference.resolve_infer_kernel(
+            n_trees=5, depth=4, n_nodes=31, n_feat=10, n_bins=32,
+            n_rows=262144)
+        assert (k2, br2, tuned2) == ("xla", 0, False)
+        assert inference.kernel_report()["tuned"] is False
+        # autotune off: the manifest is ignored entirely
+        prewarm.record_tuned("infer_kernel", key,
+                             {"kernel": "pallas", "block_rows": 512})
+        GLOBAL_CONF.set("sml.infer.autotune", False)
+        k3, _, _ = inference.resolve_infer_kernel(
+            n_trees=5, depth=4, n_nodes=31, n_feat=10, n_bins=32,
+            n_rows=4096)
+        assert k3 == "xla"
+        # the prewarm rebuilder replays the tuned program into the SAME
+        # cache the live score path hits (replica spin-up's warm start)
+        inference._replay_infer_kernel(
+            {"key": key, "spec": {"kernel": "pallas", "block_rows": 512}})
+        mesh = meshlib.get_mesh()
+        assert (4, id(mesh), "pallas", 512) in inference._forest_programs
+    finally:
+        GLOBAL_CONF.set("sml.compile.cacheDir", prev_dir or "")
+
+
+# --------------------------------------------------------- regress rules
+def test_regress_kernel_infer_rules(spark):
+    """obs/regress.py: a vanished kernel_infer sidecar block, fallback
+    growth, or a lost beats-default/replay proof is a regression;
+    driver-shaped records are exempt from the coverage rule."""
+    from sml_tpu.obs import regress
+    block = {"fallbacks": 0.0, "tuned_beats_default": True,
+             "replay_ok": True, "legs": []}
+    base = regress.normalize({"legs": {}, "kernel_infer": dict(block)})
+    ok = regress.compare(base, regress.normalize(
+        {"legs": {}, "kernel_infer": dict(block)}))
+    assert ok["ok"]
+    gone = regress.compare(base, regress.normalize({"legs": {}}))
+    assert not gone["ok"]
+    assert any(f["kind"] == "missing-kernel-infer-block"
+               for f in gone["regressions"])
+    # driver records can never carry the block: exempt
+    rec = regress.compare(base, regress.normalize(
+        {"parsed": {}, "tail": ""}))
+    assert not any(f["kind"] == "missing-kernel-infer-block"
+                   for f in rec["regressions"])
+    fell = regress.compare(base, regress.normalize(
+        {"legs": {}, "kernel_infer": dict(block, fallbacks=2.0)}))
+    assert any(f["kind"] == "infer-kernel-fallback"
+               for f in fell["regressions"])
+    lost = regress.compare(base, regress.normalize(
+        {"legs": {},
+         "kernel_infer": dict(block, tuned_beats_default=False)}))
+    assert any(f["key"] == "tuned_beats_default"
+               for f in lost["regressions"])
+    lost2 = regress.compare(base, regress.normalize(
+        {"legs": {}, "kernel_infer": dict(block, replay_ok=False)}))
+    assert any(f["key"] == "replay_ok" for f in lost2["regressions"])
+    # interpret-mode runs: every pallas block_rows candidate is the
+    # identical single-block program, so beats-default is timer noise —
+    # NOT judged as a proof (replay_ok still is)
+    ib = dict(block, interpret=True)
+    base_i = regress.normalize({"legs": {}, "kernel_infer": dict(ib)})
+    lost_i = regress.compare(base_i, regress.normalize(
+        {"legs": {},
+         "kernel_infer": dict(ib, tuned_beats_default=False)}))
+    assert not any(f["key"] == "tuned_beats_default"
+                   for f in lost_i["regressions"])
+    lost_i2 = regress.compare(base_i, regress.normalize(
+        {"legs": {}, "kernel_infer": dict(ib, replay_ok=False)}))
+    assert any(f["key"] == "replay_ok" for f in lost_i2["regressions"])
