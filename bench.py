@@ -2032,6 +2032,11 @@ def main():
                     for k, v in metrics.items()},
         "legs": per_leg,
     }
+    if LINT_STATS is not None:
+        # the --lint gate's receipts: 0 unsuppressed violations by
+        # construction (the gate refuses otherwise); suppression counts
+        # and the active-rule census are what obs/regress.py judges
+        sidecar["lint"] = LINT_STATS
     # the standalone-leg blocks (--multichip / --kernelbench) merge into
     # this sidecar from their own runs: carry them across a plain suite
     # run instead of silently dropping them — bench_diff treats a
@@ -2041,7 +2046,7 @@ def main():
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
             for block in ("multichip", "kernel", "kernel_infer", "scale",
-                          "drift"):
+                          "drift", "lint"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):
@@ -2070,9 +2075,20 @@ def main():
         sys.exit(1)
 
 
+#: stats of the --lint gate run, merged into the sidecar `lint` block
+#: (and emitted as lint.* engine counters) so obs/regress.py can flag a
+#: violation-count increase or a rule-count decrease between records
+LINT_STATS = None
+
+
 def run_graftlint() -> int:
-    """`scripts/graftlint.py` via its standalone loader (no extra
-    process, no jax import on the lint side)."""
+    """`scripts/graftlint.py`'s engine via the standalone loader (no
+    extra process, no jax import on the lint side). ONE lint pass
+    produces both the gate verdict and LINT_STATS, so the receipts can
+    never disagree with the verdict. Return contract mirrors the
+    runner's: 0 clean, 1 violations, 2 internal error — the gate
+    refuses to record on anything nonzero."""
+    global LINT_STATS
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "_graftlint_runner",
@@ -2080,7 +2096,45 @@ def run_graftlint() -> int:
                      "scripts", "graftlint.py"))
     runner = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(runner)
-    return runner.main([])
+    lint = runner.load_linter()
+    try:
+        report = lint.run(root=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:
+        print(f"bench: graftlint internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        LINT_STATS = None
+        return 2
+    print(report.format())
+    by_rule = {}
+    for v in report.violations:
+        by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+    LINT_STATS = {
+        "rules": len(report.rule_names),
+        "files": report.n_files,
+        "violations": len(report.violations),
+        "violations_by_rule": by_rule,
+        "suppressed_pragma": report.n_suppressed_pragma,
+        "suppressed_baseline": report.n_suppressed_baseline,
+    }
+    return 0 if report.clean else 1
+
+
+def _emit_lint_counters() -> None:
+    """lint.* engine counters for the flight recorder / per-leg counter
+    snapshots — called once the engine is importable (the gate itself
+    runs jax-free BEFORE any sml_tpu import)."""
+    if LINT_STATS is None:
+        return
+    from sml_tpu.utils.profiler import PROFILER
+    PROFILER.count("lint.runs")
+    PROFILER.count("lint.rules", float(LINT_STATS["rules"]))
+    PROFILER.count("lint.violations", float(LINT_STATS["violations"]))
+    PROFILER.count("lint.suppressed_pragma",
+                   float(LINT_STATS["suppressed_pragma"]))
+    PROFILER.count("lint.suppressed_baseline",
+                   float(LINT_STATS["suppressed_baseline"]))
+    for rule_name, n in sorted(LINT_STATS["violations_by_rule"].items()):
+        PROFILER.count(f"lint.rule.{rule_name}", float(n))
 
 
 if __name__ == "__main__":
@@ -2147,10 +2201,12 @@ if __name__ == "__main__":
     if args.prewarm:
         from sml_tpu.conf import GLOBAL_CONF as _CONF0
         _CONF0.set("sml.prewarm.enabled", True)
-    if args.lint and run_graftlint() != 0:
-        print("bench: refusing to record — graftlint found violations "
-              "(fix them or run without --lint)", file=sys.stderr)
-        sys.exit(1)
+    if args.lint:
+        if run_graftlint() != 0:
+            print("bench: refusing to record — graftlint found violations "
+                  "(fix them or run without --lint)", file=sys.stderr)
+            sys.exit(1)
+        _emit_lint_counters()
     entry = (pin_goldens if args.pin_goldens else
              (lambda: multichip_main(args.multichip_rows))
              if args.multichip else

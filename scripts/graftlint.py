@@ -8,8 +8,16 @@ package — and therefore never imports jax: CI can gate on this from a
 cold interpreter in well under a second (asserted by
 tests/test_lint_clean.py).
 
-Exit status: 0 clean, 1 violations, 2 usage/internal error.
-See docs/LINTING.md for the rule catalogue and suppression workflow.
+Exit-code CONTRACT (relied on by `bench.py --lint` and CI — do not
+reuse these codes for anything else):
+
+    0  clean: no unsuppressed violations (also: --list-rules,
+       --update-baseline success)
+    1  violations found (including pragma/baseline hygiene findings)
+    2  usage or internal error (unknown --rule, unreadable tree,
+       a rule crashing); argparse errors exit 2 via argparse itself
+
+See docs/LINT.md for the rule catalogue and suppression workflow.
 """
 
 from __future__ import annotations
@@ -75,6 +83,10 @@ def main(argv=None) -> int:
                                         and not args.update_baseline))
     except KeyError as e:
         print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # internal error (a rule crashed): contract = 2
+        print(f"graftlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
         return 2
 
     if args.update_baseline:

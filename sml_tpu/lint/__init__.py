@@ -5,15 +5,18 @@ compile must flow through the dispatch layer (or the routing audit and
 compile cache lie), donated buffers must never be read after dispatch
 (XLA:CPU forgives what a TPU will not), hot paths must not silently sync
 device->host, conf-key literals must exist in the conf.py registry, obs
-names must match the taxonomy, and engine timestamps must come from the
-profiler's clock. graftlint turns each of those into an AST rule with
-per-line pragmas, a reviewed baseline, and CI enforcement
+names must match the taxonomy, engine timestamps must come from the
+profiler's clock — and shared state touched from the engine's thread
+roles (flush workers, listeners, watchdogs, prefetch pools) must follow
+a lock or snapshot discipline (lint/threads.py powers the concurrency
+rules). graftlint turns each of those into an AST rule with per-line
+pragmas, a reviewed baseline, and CI enforcement
 (tests/test_lint_clean.py).
 
 Run it:            python scripts/graftlint.py
 Suppress a line:   # graftlint: disable=<rule> -- <reason>
 Carry a debt:      .graftlint-baseline.json (reviewed reasons mandatory)
-Docs:              docs/LINTING.md
+Docs:              docs/LINT.md
 
 This package is stdlib-only and is loaded STANDALONE by the runner
 (importlib by path, package name "graftlint") so linting never imports

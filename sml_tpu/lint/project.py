@@ -48,6 +48,11 @@ class FunctionInfo:
         self.node = node
         self.lineno = node.lineno
         self.calls: List[str] = []  # simple call-target names, body order
+        #: (form, name) per call: form is "name" (`f(...)`), "self"
+        #: (`self.f(...)`/`cls.f(...)`), or "attr" (`obj.f(...)`) — the
+        #: thread-role propagation (lint/threads.py) resolves each form
+        #: differently to avoid false call-graph edges
+        self.call_forms: List[Tuple[str, str]] = []
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"<fn {self.rel}:{self.qualname}>"
@@ -95,6 +100,14 @@ class _FunctionCollector(ast.NodeVisitor):
             name = call_target_name(node.func)
             if name:
                 self._current[-1].calls.append(name)
+                if isinstance(node.func, ast.Attribute):
+                    form = "self" if (
+                        isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in ("self", "cls")) \
+                        else "attr"
+                else:
+                    form = "name"
+                self._current[-1].call_forms.append((form, name))
         self.generic_visit(node)
 
 

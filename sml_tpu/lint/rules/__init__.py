@@ -1,7 +1,8 @@
 """Built-in graftlint rules. Importing this package registers them all
 in `core.RULES`; add a new rule by dropping a module here that uses the
 `@rule(name, doc)` decorator and importing it below (see
-docs/LINTING.md "Adding a rule")."""
+docs/LINT.md "Adding a rule")."""
 
 from . import (conf_keys, dispatch_bypass, donation,  # noqa: F401
-               host_sync, sharded_staging, taxonomy, wallclock)
+               host_sync, lock_order, race_check_use, race_shared_write,
+               sharded_staging, taxonomy, wallclock)

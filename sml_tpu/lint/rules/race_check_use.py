@@ -1,0 +1,80 @@
+"""Rule 9 — race-check-then-use.
+
+The PR-12 `DeviceScorer` bug, generalized: a method checks
+`self._attr` (`if self._attr is None: ...`) and then LOADS IT AGAIN to
+use it, while some other thread role can rebind the attribute between
+the two loads — the check passes, the use explodes (the fallback
+ladder's `KeyError` contract turned into `AttributeError` when the
+prefetch threads nulled `_factorized` mid-score).
+
+Flagged: >=2 loads of one `self.<attr>` in a single method, outside any
+lock that guards every foreign-role write of that attribute, when such
+a foreign writer exists. One load is atomic under the GIL and therefore
+fine — which is exactly why the fix is the snapshot idiom:
+
+    obj = self._attr          # ONE load
+    if obj is None: ...       # every later use sees the same object
+    obj.transform(X)
+
+or hold the lock the writers hold across the whole check+use. Orderings
+the analysis cannot see (the value is immutable once set and the reader
+is gated on an `Event`) get a pragma naming the ordering.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import threads
+from ..core import Violation, rule
+from ..project import Project
+
+RULE = "race-check-then-use"
+
+
+@rule(RULE,
+      "re-reading self.<attr> after a check while a foreign thread role "
+      "can rebind it — snapshot to a local (one load) or hold the "
+      "writers' lock across check+use")
+def check(project: Project) -> List[Violation]:
+    analysis = threads.analyze(project)
+    out: List[Violation] = []
+    for rec in analysis.classes:
+        if not threads.participates(analysis, rec):
+            continue
+        ement = threads.entry_methods(analysis, rec)
+
+        def lk(a):
+            return rec.effective_locks(a, ement)
+
+        for attr, accesses in sorted(rec.attr_accesses().items()):
+            post = [a for a in accesses if not a.in_init]
+            writes = [a for a in post if a.kind in ("write", "mutate")]
+            if not writes:
+                continue
+            rs = {a: threads.roleset_of(analysis, rec, a.method)
+                  for a in post}
+            for method in sorted({a.method for a in post}):
+                mset = threads.roleset_of(analysis, rec, method)
+                foreign = [w for w in writes
+                           if rs[w] != mset and (rs[w] or mset)]
+                if not foreign:
+                    continue
+                guard = lk(foreign[0])
+                for w in foreign[1:]:
+                    guard = guard & lk(w)
+                loads = [a for a in post
+                         if a.method == method and a.kind == "read"
+                         and not (lk(a) & guard)]
+                if len(loads) < 2:
+                    continue
+                w = foreign[0]
+                out.append(Violation(
+                    RULE, rec.rel, loads[1].lineno,
+                    f"`self.{attr}` is loaded {len(loads)} times in "
+                    f"`{method}` while `{w.method}` (role "
+                    f"{threads.short_role(rs[w])}) can rebind it between the loads "
+                    f"— snapshot it once (`x = self.{attr}`) and use "
+                    f"the local, or hold the writers' lock across the "
+                    f"check and the use"))
+    return out

@@ -622,8 +622,11 @@ class DeviceScorer:
     def kernel_spec(self) -> Optional[dict]:
         """The traversal spec this scorer's most recent device-routed
         forest dispatch resolved to ({kernel, block_rows, tuned}), or
-        None (linear model / no device dispatch yet)."""
-        return None if self._kernel_spec is None else dict(self._kernel_spec)
+        None (linear model / no device dispatch yet). Snapshot first:
+        a concurrent `_dispatch` (prefetch/serving threads) rebinds
+        `_kernel_spec` between a check and a `dict()` of it."""
+        spec = self._kernel_spec
+        return None if spec is None else dict(spec)
 
     def resident_bytes(self) -> int:
         """Approximate bytes a WARM scorer pins per mesh (model tensors
@@ -641,12 +644,18 @@ class DeviceScorer:
         to the featurizer's slot layout. Returns None when any source shape
         is unsupported."""
         from .featurizer import _IndexSource, _NumericSource, _OneHotSource
+        # snapshot: `_prep` (running on a prefetch lookahead thread) can
+        # null `_featurizer` between the width check and the source walk
+        # — the same race PR 12 fixed in `_score_factorized`/`_prep`
+        featurizer = self._featurizer
+        if featurizer is None:
+            return None
         w = np.asarray(self._params[0], dtype=np.float64)
-        if w.ndim != 1 or w.shape[0] != self._featurizer.width:
+        if w.ndim != 1 or w.shape[0] != featurizer.width:
             return None
         scalars, embeds = [], []
         lo = 0
-        for s in self._featurizer.sources:
+        for s in featurizer.sources:
             if isinstance(s, _OneHotSource):
                 embeds.append((s.inner, w[lo:lo + s.width].copy()))
             elif isinstance(s, (_NumericSource, _IndexSource)):

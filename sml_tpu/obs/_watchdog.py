@@ -235,8 +235,11 @@ class Watchdog:
 
     def reset(self) -> None:
         """Drop the flagged-total statistic (open tickets are LIVE state
-        — they describe real in-flight work and are never dropped)."""
-        self.flagged_total = 0
+        — they describe real in-flight work and are never dropped). The
+        flagger thread increments `flagged_total` under `_lock`; an
+        unguarded reset racing it would resurrect the dropped count."""
+        with self._lock:
+            self.flagged_total = 0
 
 
 WATCHDOG = Watchdog()

@@ -109,6 +109,7 @@ def normalize(doc: dict) -> dict:
             "kernel_infer": doc.get("kernel_infer"),
             "scale": doc.get("scale"),
             "drift": doc.get("drift"),
+            "lint": doc.get("lint"),
             "shape": "sidecar",
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
@@ -136,6 +137,7 @@ def normalize(doc: dict) -> dict:
         "kernel_infer": doc.get("kernel_infer"),
         "scale": doc.get("scale"),
         "drift": doc.get("drift"),
+        "lint": doc.get("lint"),
         "shape": "record",
     }
 
@@ -466,6 +468,35 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                     0.0, 0.0, "regression",
                     "baseline save/load round trip no longer "
                     "bit-compatible (reload self-distance != 0)"))
+
+    # ---- lint block (static-analysis gate receipts)
+    bln, cln = base.get("lint"), cand.get("lint")
+    if bln and not cln and cand.get("shape") != "record":
+        # coverage rule, like the kernel/scale/drift blocks: a sidecar
+        # candidate missing the block lost the --lint gate (bench.py
+        # carries it across plain suite runs); driver records exempt
+        reg.append(_finding(
+            "missing-lint-block", "lint", 1.0, 0.0, 0.0, "regression",
+            "graftlint gate block present in base, absent in candidate"))
+    if bln and cln:
+        bv = float(bln.get("violations", 0))
+        cv = float(cln.get("violations", 0))
+        checked += 1
+        if cv > bv:
+            reg.append(_finding(
+                "lint-violations", "violations", bv, cv, 0.0,
+                "regression",
+                "unsuppressed graftlint violation count grew — the tree "
+                "was recorded dirty"))
+        br = float(bln.get("rules", 0))
+        cr = float(cln.get("rules", 0))
+        if br:
+            checked += 1
+            if cr < br:
+                reg.append(_finding(
+                    "lint-rules", "rules", br, cr, 0.0, "regression",
+                    "active graftlint rule count shrank — invariant "
+                    "coverage loss"))
 
     return {"ok": not reg, "regressions": reg, "improvements": imp,
             "checked": checked}

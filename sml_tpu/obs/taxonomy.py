@@ -103,6 +103,14 @@ COUNTERS = {
     # observation callbacks that raised (observation must never fail a
     # flush, but a dead observer must be visible)
     "drift.*",
+    # graftlint gate receipts (bench.py --lint): lint.runs /
+    # lint.violations (unsuppressed — 0 on any recorded run, the gate
+    # refuses otherwise) / lint.suppressed_pragma /
+    # lint.suppressed_baseline / lint.rules (active rule count) /
+    # lint.rule.<name> per-rule live-violation counts — obs/regress.py
+    # flags a violation-count increase or a rule-count decrease between
+    # committed sidecars
+    "lint.*",
 }
 
 GAUGES = {

@@ -69,8 +69,13 @@ class ScoreFuture:
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._event.wait(timeout):
             raise TimeoutError("serving request still queued/in flight")
-        if self._error is not None:
-            raise self._error
+        # snapshot: the flush worker writes `_error`/`_value` before
+        # `_event.set()`, but a second setter (close() draining a queue
+        # the worker is still flushing) may rebind between our check and
+        # the raise — one load each makes the read atomic
+        err = self._error
+        if err is not None:
+            raise err
         return self._value
 
     def _set(self, value: np.ndarray) -> None:

@@ -147,23 +147,34 @@ class ServingEndpoint:
         distribution it was trained on. Models without a baseline
         (linear, pre-drift artifacts) serve unmonitored."""
         key = self._drift_key()
-        spec = getattr(getattr(self._scorer, "_model", None), "_spec", None)
-        baseline = getattr(spec, "baseline", None)
-        old = self._drift
-        if baseline is None:
-            self._drift = None
-            if old is not None:
-                _drift.DRIFT.unregister(key, old)
-        elif old is not None and old.baseline is baseline:
-            # same version: re-assert the registration (self-heals if a
-            # same-keyed endpoint's close ever raced it away)
-            _drift.DRIFT.register(key, old)
-        else:
-            # a hot-swap re-baselines: the new version's training
-            # distribution is the comparison target from here on
-            mon = _drift.DriftMonitor(baseline, name=key)
-            self._drift = mon
-            _drift.DRIFT.register(key, mon)
+        # `_drift` is written from the stage-transition listener thread
+        # (via _refresh) AND from close(): every rebind holds _swap_lock
+        # so a close racing a hot-swap cannot leave a monitor registered
+        # with no owner (readers snapshot — `_observe_scores`)
+        with self._swap_lock:
+            if self._closed:
+                # a close() that already swept `_drift` must not have a
+                # straggling listener re-register a monitor on a dead
+                # endpoint (close sets _closed before taking this lock)
+                return
+            spec = getattr(getattr(self._scorer, "_model", None),
+                           "_spec", None)
+            baseline = getattr(spec, "baseline", None)
+            old = self._drift
+            if baseline is None:
+                self._drift = None
+                if old is not None:
+                    _drift.DRIFT.unregister(key, old)
+            elif old is not None and old.baseline is baseline:
+                # same version: re-assert the registration (self-heals if
+                # a same-keyed endpoint's close ever raced it away)
+                _drift.DRIFT.register(key, old)
+            else:
+                # a hot-swap re-baselines: the new version's training
+                # distribution is the comparison target from here on
+                mon = _drift.DriftMonitor(baseline, name=key)
+                self._drift = mon
+                _drift.DRIFT.register(key, mon)
 
     def _observe_scores(self, X, preds, traces) -> None:
         """MicroBatcher observer: feed the scored block into the live
@@ -322,9 +333,12 @@ class ServingEndpoint:
             _store.remove_stage_listener(self._listener)
             self._listener = None
         self._batcher.close()
-        if self._drift is not None:
-            _drift.DRIFT.unregister(self._drift_key(), self._drift)
-            self._drift = None
+        # take the monitor under the same lock _install_drift rebinds it
+        # under; unregister outside the lock (registry has its own)
+        with self._swap_lock:
+            mon, self._drift = self._drift, None
+        if mon is not None:
+            _drift.DRIFT.unregister(self._drift_key(), mon)
         with self._canary_lock:
             pool, self._shadow_pool = self._shadow_pool, None
         if pool is not None:

@@ -320,8 +320,12 @@ class StreamingQuery:
     def processAllAvailable(self) -> None:
         while any(f not in self._processed for f in self._sdf._list_files()):
             if not self.isActive:
-                if self._exception is not None:
-                    raise RuntimeError("streaming query terminated with error") from self._exception
+                # snapshot: the trigger thread publishes `_exception`
+                # before setting `_stop`; one load keeps check+raise
+                # atomic against a late rebind
+                exc = self._exception
+                if exc is not None:
+                    raise RuntimeError("streaming query terminated with error") from exc
                 return
             time.sleep(0.05)
 
@@ -330,4 +334,7 @@ class StreamingQuery:
 
     @property
     def lastProgress(self) -> Optional[Dict[str, Any]]:
-        return self.recentProgress[-1] if self.recentProgress else None
+        # snapshot: the trigger thread appends to `recentProgress`
+        # between our emptiness check and the [-1] index otherwise
+        progress = self.recentProgress
+        return progress[-1] if progress else None

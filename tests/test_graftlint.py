@@ -454,11 +454,348 @@ def test_unsharded_put_pragma_suppresses(lint):
     assert rep.clean and rep.n_suppressed_pragma == 1
 
 
+# ----------------------------------------- the thread-role map (PR 13 core)
+def _threads_mod():
+    return sys.modules["graftlint.threads"]
+
+
+def test_thread_role_map_entries_and_propagation(lint):
+    """Thread(target=self._loop) seeds a role that propagates through
+    intra-class calls; methods only the caller reaches stay main-only."""
+    project = lint.Project.from_sources({"sml_tpu/a.py": (
+        "import threading\n"
+        "class Pump:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self._step()\n"
+        "    def _step(self):\n"
+        "        pass\n"
+        "    def poll(self):\n"
+        "        pass\n")})
+    roles = _threads_mod().thread_roles(project)
+    assert any(r.startswith("thread:")
+               for r in roles.get("sml_tpu/a.py::Pump._loop", ()))
+    assert any(r.startswith("thread:")
+               for r in roles.get("sml_tpu/a.py::Pump._step", ()))
+    assert not roles.get("sml_tpu/a.py::Pump.poll")
+    assert not roles.get("sml_tpu/a.py::Pump.start")
+
+
+def test_thread_role_map_submit_callback_and_escape_entries(lint):
+    """executor.submit(fn), listener registrations, and bound-method
+    escapes into a constructor each seed their own role kind."""
+    project = lint.Project.from_sources({"sml_tpu/a.py": (
+        "class Svc:\n"
+        "    def wire(self, ex, store):\n"
+        "        ex.submit(self._work, 1)\n"
+        "        store.on_stage_transition(self._on_swap)\n"
+        "        Batcher(self._score)\n"
+        "    def _work(self, x):\n"
+        "        pass\n"
+        "    def _on_swap(self):\n"
+        "        pass\n"
+        "    def _score(self):\n"
+        "        pass\n")})
+    roles = _threads_mod().thread_roles(project)
+    kinds = {qual.rsplit(".", 1)[-1]: sorted(rs)[0].split(":", 1)[0]
+             for qual, rs in roles.items() if rs}
+    assert kinds.get("_work") == "thread"
+    assert kinds.get("_on_swap") == "callback"
+    assert kinds.get("_score") == "escape"
+
+
+def test_thread_role_map_properties_do_not_escape(lint):
+    """A bare `self.schema` load on a @property is attribute access,
+    not a callable hand-off — no escape role, no participation."""
+    project = lint.Project.from_sources({"sml_tpu/a.py": (
+        "class Frame:\n"
+        "    @property\n"
+        "    def schema(self):\n"
+        "        return self._s\n"
+        "    def use(self):\n"
+        "        return self.schema\n")})
+    assert not any(rs for rs in
+                   _threads_mod().thread_roles(project).values())
+
+
+# ---------------------------------------- rule 8: race-unguarded-shared-write
+RACEW = ["race-unguarded-shared-write"]
+
+_RACEW_POS = (
+    "import threading\n"
+    "class Pump:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._n = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop).start()\n"
+    "    def _loop(self):\n"
+    "        self._n += 1\n"
+    "    def bump(self):\n"
+    "        self._n += 1\n")
+
+
+def test_race_write_multi_role_unguarded_flagged(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": _RACEW_POS}, rules=RACEW)
+    assert rules_fired(rep) == RACEW
+    assert all("_n" in v.message for v in rep.violations)
+
+
+def test_race_write_lock_guarded_clean(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n")}, rules=RACEW)
+    assert rep.clean
+
+
+def test_race_write_helper_under_callers_lock_clean(lint):
+    """A private helper whose every intra-class call site holds the lock
+    inherits it (the `_ensure_sink`-under-`emit` convention)."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "    def _bump_locked(self):\n"
+        "        self._n += 1\n")}, rules=RACEW)
+    assert rep.clean
+
+
+def test_race_write_publish_with_snapshot_reader_clean(lint):
+    """Single-writer rebind + one-load readers is the sanctioned
+    publish pattern (the PR-12 fix idiom) — not a violation."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Pub:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cur = None\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self._cur = object()\n"
+        "    def read(self):\n"
+        "        cur = self._cur\n"
+        "        return cur\n")}, rules=RACEW)
+    assert rep.clean
+
+
+def test_race_write_instance_confined_class_not_judged(lint):
+    """A value class merely REACHABLE from someone else's thread (no
+    lock, no own entry) is instance-confined by convention — the
+    participation filter keeps builder/frame classes out of scope."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Builder:\n"
+        "    def mode(self, m):\n"
+        "        self._mode = m\n"
+        "        return self\n"
+        "    def save(self):\n"
+        "        if self._mode:\n"
+        "            return self._mode\n"
+        "def run():\n"
+        "    Builder().mode('x').save()\n"
+        "def spin():\n"
+        "    threading.Thread(target=run).start()\n")},
+        rules=RACEW + ["race-check-then-use"])
+    assert rep.clean
+
+
+def test_race_write_pragma_suppresses_with_reason(lint):
+    # every unguarded write site flags, so each carries its own pragma
+    src = _RACEW_POS.replace(
+        "        self._n += 1\n",
+        "        self._n += 1  # graftlint: disable="
+        "race-unguarded-shared-write -- fixture: ordered by Event\n")
+    rep = run_on(lint, {"sml_tpu/a.py": src}, rules=RACEW)
+    assert rep.clean and rep.n_suppressed_pragma == 2
+
+
+def test_race_write_baseline_suppresses(lint, tmp_path):
+    baseline_mod = sys.modules["graftlint.baseline"]
+    rep = run_on(lint, {"sml_tpu/a.py": _RACEW_POS}, rules=RACEW)
+    assert not rep.clean
+    base = tmp_path / "base.json"
+    baseline_mod.update(str(base), rep.violations)
+    entries = baseline_mod.load(str(base))
+    for e in entries:
+        e["reason"] = "fixture: reviewed"
+    baseline_mod.save(str(base), entries)
+    rep2 = run_on(lint, {"sml_tpu/a.py": _RACEW_POS}, rules=RACEW,
+                  use_baseline=True, baseline_path=str(base))
+    assert rep2.clean and rep2.n_suppressed_baseline >= 1
+
+
+# --------------------------------------------- rule 9: race-check-then-use
+RACEC = ["race-check-then-use"]
+
+#: the PR-12 DeviceScorer bug, reconstructed: prefetch lookahead threads
+#: null `_factorized` mid-score, turning the KeyError fallback ladder
+#: into AttributeError
+_PR12_BUG = (
+    "class Scorer:\n"
+    "    def __init__(self):\n"
+    "        import threading\n"
+    "        self._done = threading.Event()\n"
+    "        self._factorized = None\n"
+    "    def prefetch(self, ex, batches):\n"
+    "        for b in batches:\n"
+    "            ex.submit(self._prep, b)\n"
+    "    def _prep(self, b):\n"
+    "        self._factorized = None\n"
+    "    def score(self, X):\n"
+    "        if self._factorized is None:\n"
+    "            raise KeyError('cold scorer')\n"
+    "        return self._factorized.transform(X)\n")
+
+_PR12_FIXED = _PR12_BUG.replace(
+    "    def score(self, X):\n"
+    "        if self._factorized is None:\n"
+    "            raise KeyError('cold scorer')\n"
+    "        return self._factorized.transform(X)\n",
+    "    def score(self, X):\n"
+    "        fact = self._factorized\n"
+    "        if fact is None:\n"
+    "            raise KeyError('cold scorer')\n"
+    "        return fact.transform(X)\n")
+
+
+def test_check_then_use_pr12_reconstruction_flagged(lint):
+    rep = run_on(lint, {"sml_tpu/ml/scorer.py": _PR12_BUG}, rules=RACEC)
+    assert rules_fired(rep) == RACEC
+    v = rep.violations[0]
+    assert "_factorized" in v.message and "snapshot" in v.message
+    # anchored at the SECOND load (the use after the check)
+    assert v.line == 14
+
+
+def test_check_then_use_snapshot_fix_clean(lint):
+    rep = run_on(lint, {"sml_tpu/ml/scorer.py": _PR12_FIXED},
+                 rules=RACEC + RACEW)
+    assert rep.clean
+
+
+def test_check_then_use_reads_under_writers_lock_clean(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._obj = None\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._obj = object()\n"
+        "    def use(self):\n"
+        "        with self._lock:\n"
+        "            if self._obj is not None:\n"
+        "                return self._obj\n")}, rules=RACEC)
+    assert rep.clean
+
+
+def test_check_then_use_single_role_clean(lint):
+    """Both methods on the same single thread role: sequential, no
+    race, no finding."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._obj = None\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self._obj = object()\n"
+        "        if self._obj is not None:\n"
+        "            return self._obj\n")}, rules=RACEC)
+    assert rep.clean
+
+
+# --------------------------------------------------------- rule 10: lock-order
+ORDER = ["lock-order"]
+
+
+def test_lock_order_abba_flagged_at_both_sites(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def one():\n"
+        "    with _a:\n"
+        "        with _b:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with _b:\n"
+        "        with _a:\n"
+        "            pass\n")}, rules=ORDER)
+    assert rules_fired(rep) == ORDER
+    assert len(rep.violations) == 2
+    assert all("ABBA" in v.message for v in rep.violations)
+
+
+def test_lock_order_consistent_nesting_clean(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def one():\n"
+        "    with _a:\n"
+        "        with _b:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with _a:\n"
+        "        with _b:\n"
+        "            pass\n")}, rules=ORDER)
+    assert rep.clean
+
+
+def test_lock_order_sees_class_and_module_locks_across_files(lint):
+    rep = run_on(lint, {
+        "sml_tpu/a.py": (
+            "import threading\n"
+            "_m = threading.Lock()\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with _m:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with _m:\n"
+            "            with self._lock:\n"
+            "                pass\n")}, rules=ORDER)
+    assert len(rep.violations) == 2
+
+
 # ------------------------------------------------------------ the live tree
 EXPECTED_RULES = {"host-sync-in-hot-path", "dispatch-bypass",
                   "conf-key-registry", "donation-after-use",
                   "obs-taxonomy", "no-wallclock-in-engine",
-                  "unsharded-device-put"}
+                  "unsharded-device-put", "race-unguarded-shared-write",
+                  "race-check-then-use", "lock-order"}
 
 
 def test_live_tree_clean_modulo_baseline(lint):

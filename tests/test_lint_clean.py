@@ -84,6 +84,64 @@ def test_graftlint_json_reports_suppressions():
     assert payload["suppressed"]["pragma"] >= 1
 
 
+def test_exit_code_contract(tmp_path, capsys):
+    """The documented contract (scripts/graftlint.py docstring): 0
+    clean, 1 violations, 2 usage/internal error — relied on by the
+    bench gate and CI. All three legs drive main() itself."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_g_contract", RUNNER)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    assert m.main([]) == 0                          # clean tree
+    assert m.main(["--rule", "no-such-rule"]) == 2  # usage error
+    assert m.main(["--list-rules"]) == 0
+    # violations -> 1: a minimal violated tree under --root (absent
+    # targets are simply empty)
+    os.makedirs(tmp_path / "sml_tpu")
+    (tmp_path / "sml_tpu" / "a.py").write_text(
+        "import time\nt = time.time()\n")
+    capsys.readouterr()
+    assert m.main(["--root", str(tmp_path)]) == 1
+    assert "no-wallclock-in-engine" in capsys.readouterr().out
+    out = subprocess.run([sys.executable, RUNNER, "--rule", "bogus"],
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 2
+
+
+def test_regress_flags_lint_block_loss_and_violation_growth():
+    """obs/regress.py judges the sidecar `lint` block: a vanished block
+    (sidecar candidates), an unsuppressed-violation increase, or an
+    active-rule-count decrease each flag as a regression."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_regress_lint", os.path.join(REPO, "sml_tpu", "obs",
+                                      "regress.py"))
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+    lint_block = {"rules": 10, "files": 104, "violations": 0,
+                  "suppressed_pragma": 88, "suppressed_baseline": 3}
+    base = regress.normalize({"legs": {}, "lint": dict(lint_block)})
+    same = regress.normalize({"legs": {}, "lint": dict(lint_block)})
+    assert regress.compare(base, same)["ok"]
+    gone = regress.normalize({"legs": {}})
+    res = regress.compare(base, gone)
+    assert not res["ok"]
+    assert any(f["kind"] == "missing-lint-block"
+               for f in res["regressions"])
+    dirty = regress.normalize({"legs": {},
+                               "lint": dict(lint_block, violations=2)})
+    res2 = regress.compare(base, dirty)
+    assert any(f["kind"] == "lint-violations" for f in res2["regressions"])
+    shrunk = regress.normalize({"legs": {},
+                                "lint": dict(lint_block, rules=9)})
+    res3 = regress.compare(base, shrunk)
+    assert any(f["kind"] == "lint-rules" for f in res3["regressions"])
+    # driver records can never carry the block: exempt from coverage
+    rec = regress.normalize({"parsed": {}, "tail": ""})
+    assert regress.compare(base, rec)["ok"]
+
+
 def test_bench_lint_gate_refuses_dirty_tree(tmp_path):
     """Copy the lintable surface, inject a violation, and check
     `bench.py --lint` exits 1 with the refusal message BEFORE doing any
