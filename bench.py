@@ -1738,6 +1738,225 @@ def drift_main(rows: int) -> None:
         sys.exit(1)
 
 
+# --------------------------------------------------- continuous-training leg
+CT_ROWS = 24_000
+CT_F = 6
+
+
+def _ct_frame(rows, seed, shift=False):
+    """Synthetic (X, y) for the continuous-training leg: `shift=True`
+    injects the covariate drift the trainer must catch (f0 location,
+    f2 scale) — the label function is unchanged, so a warm-start refit
+    on the drifted window genuinely improves window RMSE."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, CT_F))
+    if shift:
+        X[:, 0] += 1.5
+        X[:, 2] *= 2.0
+    y = (3.0 * X[:, 0] + 0.5 * X[:, 2] - X[:, 1] ** 2
+         + rng.normal(0, 0.3, rows)).astype(np.float32)
+    return X, y
+
+
+def run_ct(rows: int = CT_ROWS) -> dict:
+    """`--ct`: the closed-loop continuous-training proof (ISSUE 14) —
+    seed a baseline-carrying GBT into the registry and serve it, then
+    run `sml_tpu.ct.ContinuousTrainer` over two live Delta streams:
+
+    - a DRIFTING stream (injected covariate shift appended as new Delta
+      versions) must trigger >= 1 WARM-START refit whose new version
+      passes the canary gate (Staging mirror via sml.serve
+      .canaryFraction, zero canary/request errors, window-quality win)
+      and hot-swaps Production on the live endpoint;
+    - an IID control stream must trigger ZERO refits across the same
+      number of cycles (the drift trigger's no-false-positive proof).
+
+    Results merge into the bench sidecar as the `ct` block, rendered by
+    scripts/render_perf.py; a vanished block, a lost promotion, or a
+    refit on the iid control is flagged by obs/regress.py."""
+    import shutil
+    import tempfile
+
+    import jax
+    import pandas as pd
+
+    import sml_tpu.tracking as mlflow
+    from sml_tpu import TpuSession, obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ct import CanaryGate, ContinuousTrainer, DeltaChunkSource
+    from sml_tpu.frame._chunks import ArrayChunkSource
+    from sml_tpu.ml._chunked import fit_ensemble_chunked
+    from sml_tpu.ml.regression import GBTRegressionModel
+    from sml_tpu.serving import ServingEndpoint
+    from sml_tpu.tracking import _store
+    from sml_tpu.utils.profiler import PROFILER
+
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    prev_prof = GLOBAL_CONF.get("sml.profiler.enabled")
+    prev_uri = _store.get_tracking_uri()
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    GLOBAL_CONF.set("sml.profiler.enabled", True)  # hot-swap receipts
+    tmp = tempfile.mkdtemp(prefix="sml-ct-bench-")
+    mlflow.set_tracking_uri(os.path.join(tmp, "runs"))
+    spark = TpuSession.builder.appName("ct-bench").getOrCreate()
+    cols = [f"f{i}" for i in range(CT_F)]
+    try:
+        obs.reset()
+        # ---- seed model: baseline-carrying boosted ensemble, v1 in
+        # Production, served with the canary mirror armed
+        Xt, yt = _ct_frame(rows, seed=11)
+        t0 = time.perf_counter()
+        spec = fit_ensemble_chunked(
+            ArrayChunkSource(Xt, yt, chunk_rows=max(rows // 8, 1)),
+            categorical={}, max_depth=4, max_bins=32, n_trees=8,
+            seed=7, loss="squared", step_size=0.3, boosting=True)
+        fit_s = time.perf_counter() - t0
+        assert spec.baseline is not None, "seed fit did not stamp a baseline"
+        # the same seed model anchors TWO independent lineages: the
+        # drifting pipeline (whose promotion moves ITS Production) and
+        # the iid control (whose baseline must stay the seed model —
+        # sharing one name would make the control judge iid data
+        # against the drift-refit model and "detect" the promotion)
+        with mlflow.start_run():
+            mlflow.spark.log_model(GBTRegressionModel(spec), "model",
+                                   registered_model_name="ct-bench-model")
+            mlflow.spark.log_model(GBTRegressionModel(spec), "model-iid",
+                                   registered_model_name="ct-bench-iid")
+        _store.set_version_stage("ct-bench-model", 1, "Production")
+        _store.set_version_stage("ct-bench-iid", 1, "Production")
+
+        def append(path, batch_rows, seed, shift):
+            X, y = _ct_frame(batch_rows, seed, shift)
+            pdf = pd.DataFrame({c: X[:, i] for i, c in enumerate(cols)})
+            pdf["y"] = y.astype(float)
+            mode = "append" if os.path.exists(path) else "errorifexists"
+            spark.createDataFrame(pdf).write.format("delta") \
+                .mode(mode).save(path)
+
+        batch = max(rows // 8, 1024)
+        gate = CanaryGate(min_mirrored=4, timeout_s=30.0,
+                          quality_tol=1.2, batch_rows=256)
+        swaps0 = PROFILER.counters().get("serve.hot_swap", 0.0)
+
+        # ---- drifting stream: refit -> gate -> promote -> hot-swap
+        dpath = os.path.join(tmp, "drift-stream")
+        t0 = time.perf_counter()
+        with ServingEndpoint("ct-bench-model", "Production",
+                             canary_fraction=1.0, flush_micros=500) as ep:
+            trainer = ContinuousTrainer(
+                "ct-bench-model", DeltaChunkSource(dpath, cols, "y"),
+                endpoint=ep, gate=gate,
+                fit_params={"seed": 7, "rounds_per_dispatch": 2},
+                warm_rounds=4, min_rows=512, full_severity=1e9)
+            append(dpath, batch, seed=21, shift=False)
+            clean = trainer.step()
+            append(dpath, batch, seed=22, shift=True)
+            drifted = trainer.step()
+            dstats = trainer.stats()
+            endpoint_version = ep.current_version()
+        loop_s = time.perf_counter() - t0
+        swaps = PROFILER.counters().get("serve.hot_swap", 0.0) - swaps0
+
+        # ---- iid control stream: same cadence, zero refits
+        ipath = os.path.join(tmp, "iid-stream")
+        control = ContinuousTrainer(
+            "ct-bench-iid", DeltaChunkSource(ipath, cols, "y"),
+            gate=gate, fit_params={"seed": 7},
+            warm_rounds=4, min_rows=512, full_severity=1e9)
+        for i in range(2):
+            append(ipath, batch, seed=31 + i, shift=False)
+            control.step()
+        istats = control.stats()
+
+        gate_verdict = (drifted.get("gate") or {})
+        block = {
+            "rows": rows,
+            "n_features": CT_F,
+            "backend": jax.default_backend(),
+            "seed_fit_seconds": round(fit_s, 3),
+            "loop_seconds": round(loop_s, 3),
+            "drift": {
+                "cycles": dstats["cycles"],
+                "clean_cycles": dstats["clean"],
+                "refits": dstats["refits"],
+                "warm_refits": dstats["warm_refits"],
+                "full_refits": dstats["full_refits"],
+                "severity": float(drifted.get("severity", 0.0)),
+                "clean_severity": float(clean.get("severity", 0.0)),
+                "promoted": bool(dstats["promotions"] >= 1),
+                "rollbacks": dstats["rollbacks"],
+                "endpoint_version": endpoint_version,
+                "hot_swap": bool(swaps >= 1),
+                "request_errors": int(
+                    gate_verdict.get("request_errors", -1)),
+                "gate": {k: gate_verdict.get(k) for k in
+                         ("passed", "mirrored", "canary_errors",
+                          "request_errors", "mean_abs_diff",
+                          "rmse_candidate", "rmse_incumbent")},
+            },
+            "iid": {
+                "cycles": istats["cycles"],
+                "refits": istats["refits"],
+                "severity": float((control.last_report or {})
+                                  .get("severity", 0.0)),
+            },
+            "note": "closed loop: Delta appends -> snapshot/advance "
+                    "watermark -> PR-11 ingest drift monitor -> "
+                    "warm-start round append under the saved bin edges "
+                    "-> registry version -> Staging canary mirror -> "
+                    "gate -> Production hot-swap "
+                    "(docs/CONTINUOUS_TRAINING.md)",
+        }
+        ok = (block["drift"]["promoted"] and block["drift"]["hot_swap"]
+              and block["drift"]["warm_refits"] >= 1
+              and block["drift"]["request_errors"] == 0
+              and block["drift"]["endpoint_version"] == 2
+              and block["iid"]["refits"] == 0)
+        block["closed_loop_ok"] = bool(ok)
+        print(f"  ct: drift severity {block['drift']['severity']:.1f} -> "
+              f"{block['drift']['warm_refits']} warm refit(s), promoted="
+              f"{block['drift']['promoted']} (endpoint v"
+              f"{block['drift']['endpoint_version']}, hot_swap="
+              f"{block['drift']['hot_swap']}, request_errors="
+              f"{block['drift']['request_errors']}); iid control "
+              f"{block['iid']['refits']} refits over "
+              f"{block['iid']['cycles']} cycles (severity "
+              f"{block['iid']['severity']:.2f})", file=sys.stderr)
+        return block
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
+        GLOBAL_CONF.set("sml.profiler.enabled", bool(prev_prof))
+        mlflow.set_tracking_uri(prev_uri)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def ct_main(rows: int) -> None:
+    """Run the continuous-training leg standalone, merge the `ct` block
+    into the bench sidecar, and print the short headline JSON last."""
+    block = run_ct(rows)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["ct"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "continuous-training closed loop (drift stream "
+                  "promotes, iid stream holds)",
+        "value": 1.0 if block["closed_loop_ok"] else 0.0,
+        "unit": "1 = warm refit fired + canary gate promoted + "
+                "hot-swap + zero request errors + zero iid refits",
+        "warm_refits": block["drift"]["warm_refits"],
+        "promoted": block["drift"]["promoted"],
+        "iid_refits": block["iid"]["refits"],
+        "backend": block["backend"],
+        "legs_file": "bench_legs.json",
+    }))
+    if not block["closed_loop_ok"]:
+        sys.exit(1)
+
+
 # ----------------------------------------------------------------- goldens
 def check_goldens(metrics):
     """Compare this run's metric values against the CPU-mesh 1M-row pins
@@ -2046,7 +2265,7 @@ def main():
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
             for block in ("multichip", "kernel", "kernel_infer", "scale",
-                          "drift", "lint"):
+                          "drift", "lint", "ct"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):
@@ -2185,6 +2404,17 @@ if __name__ == "__main__":
                              "proof fails")
     parser.add_argument("--drift-rows", type=int, default=DRIFT_ROWS,
                         help="training rows for the --drift leg")
+    parser.add_argument("--ct", action="store_true",
+                        help="run ONLY the continuous-training closed-"
+                             "loop proof (seed GBT registered + served, "
+                             "drifting Delta stream triggers a warm-"
+                             "start refit that passes the canary gate "
+                             "and hot-swaps Production; iid control "
+                             "stream triggers zero refits) and merge "
+                             "the `ct` block into the bench sidecar; "
+                             "exits 1 when any proof fails")
+    parser.add_argument("--ct-rows", type=int, default=CT_ROWS,
+                        help="seed-model training rows for the --ct leg")
     parser.add_argument("--lint", action="store_true",
                         help="gate the run on a clean graftlint pass: a "
                              "bench record from a tree violating engine "
@@ -2214,6 +2444,8 @@ if __name__ == "__main__":
              if args.kernelbench else
              (lambda: drift_main(args.drift_rows))
              if args.drift else
+             (lambda: ct_main(args.ct_rows))
+             if args.ct else
              (lambda: scale_main(args.rows))
              if args.rows else main)
     if args.blackbox_on_fail:

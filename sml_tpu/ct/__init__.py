@@ -1,0 +1,100 @@
+"""Continuous training: streaming micro-batches → warm-start incremental
+boosting → drift-triggered refit → canary-gated auto-promotion.
+
+The streaming, Delta, registry, and serving layers exist in-tree as
+separate subsystems; this package closes the loop from live data to a
+promoted model, riding machinery every prior layer already owns:
+
+- **Live sources** (`_sources`): `StreamChunkSource` adapts a
+  memory-sink `StreamingQuery`'s committed micro-batches into the
+  out-of-core `ChunkSource` protocol; `DeltaChunkSource` streams the
+  add-files of Delta versions past a consumed watermark. Both freeze a
+  `snapshot()` window so the two-pass ingest (sketch, then quantize +
+  double-buffered H2D) streams the SAME rows twice, and `advance()`
+  moves the watermark only after the window is consumed.
+- **Warm-start incremental boosting** (`ml/_tree_models
+  .warm_start_ensemble` / `ml/_chunked.warm_start_ensemble_chunked`):
+  resume a saved `_EnsembleSpec` and append rounds on fresh chunks via
+  the existing `sml.tree.roundsPerDispatch` staged dispatch — k saved
+  rounds + (N-k) appended rounds fit the N-round model bit-identically
+  on the same data/seed.
+- **Round-level checkpoints** (`_checkpoint.BoostCheckpoint` /
+  `checkpointed_fit`): every dispatch boundary persists the partial
+  ensemble, so an interrupted or preempted fit resumes mid-boost
+  (bit-identically) instead of restarting — the coordination/straggler
+  failure story of long-running distributed fits (arXiv:1612.01437)
+  applied to round-append boosting (arXiv:1806.11248).
+- **The controller** (`_trainer.ContinuousTrainer`): each cycle judges
+  the source's fresh window against the Production model's training
+  baseline through the PR-11 ingest drift monitor (the
+  `engine_health()["drift"]["ingest"]` block), schedules a refit when
+  severity clears `sml.ct.warmSeverity` (warm-start round append) or
+  `sml.ct.fullSeverity` (full re-sketch/re-bin fit), tracks every refit
+  as a registry run + version, and walks the promotion ladder.
+- **The canary gate** (`_gate.CanaryGate`): a candidate version serves
+  as Staging canary through the existing `sml.serve.canaryFraction`
+  mirror on the live endpoint; it promotes to Production (firing the
+  registry stage-transition listeners — the serving hot-swap) only when
+  the mirror accumulated cleanly (zero canary/request errors, finite
+  divergence) and the candidate's window quality clears
+  `sml.ct.gateQualityTol`; a failed gate auto-rolls back to Archived
+  and dumps a black-box forensics bundle.
+
+Knob table and the promotion-gate ladder: docs/CONTINUOUS_TRAINING.md.
+"""
+
+from __future__ import annotations
+
+from ..conf import _register
+
+_register("sml.ct.warmSeverity", 1.0, float,
+          "Drift severity (max live-vs-baseline distance as a multiple "
+          "of its noise-aware threshold, from the ingest drift monitor) "
+          "at or above which a trainer cycle schedules a WARM-START "
+          "refit: append sml.ct.warmRounds boosting rounds on the "
+          "drifted window under the saved model's bin edges. 1.0 = any "
+          "flagged feature triggers")
+_register("sml.ct.fullSeverity", 100.0, float,
+          "Drift severity at or above which the refit is FULL instead "
+          "of warm-start: re-sketch, re-bin, and refit from scratch on "
+          "the fresh window (the saved edges no longer describe the "
+          "stream). A schema-mismatched window always refits full")
+_register("sml.ct.warmRounds", 8, int,
+          "Boosting rounds appended per warm-start refit (the round "
+          "budget of one incremental update; full refits use the "
+          "trainer's fit_params n_trees)")
+_register("sml.ct.minRefitRows", 512, int,
+          "Minimum rows in the source's fresh window before a trainer "
+          "cycle judges it: smaller windows keep accumulating (the "
+          "watermark does not advance) instead of refitting on noise")
+_register("sml.ct.pollSec", 2.0, float,
+          "ContinuousTrainer.start() loop interval: seconds between "
+          "cycles of the background trainer thread")
+_register("sml.ct.canaryMinMirrored", 8, int,
+          "Canary-gate mirror quorum: shadow scores the Staging "
+          "candidate must accumulate (via sml.serve.canaryFraction "
+          "mirroring on the live endpoint) before the gate judges; an "
+          "unmet quorum inside sml.ct.gateTimeoutSec fails the gate")
+_register("sml.ct.gateTimeoutSec", 20.0, float,
+          "Canary-gate wall bound: seconds the gate waits for the "
+          "mirror quorum while driving the window through the endpoint "
+          "before declaring the canary unobservable (gate fails closed)")
+_register("sml.ct.gateQualityTol", 1.1, float,
+          "Promotion quality bar: the candidate's RMSE on the gate "
+          "window must be <= the incumbent's RMSE times this tolerance "
+          "(a drift-triggered refit should WIN on drifted data; the "
+          "tolerance admits ties on iid windows)")
+_register("sml.ct.gateRows", 2048, int,
+          "Rows of the fresh window replayed through the endpoint as "
+          "gate traffic (bounds the gate's scoring cost; also the "
+          "quality-check sample size)")
+
+from ._sources import DeltaChunkSource, StreamChunkSource  # noqa: E402
+from ._checkpoint import (BoostCheckpoint, checkpointed_fit,  # noqa: E402
+                          checkpointed_warm_start)
+from ._gate import CanaryGate  # noqa: E402
+from ._trainer import ContinuousTrainer  # noqa: E402
+
+__all__ = ["StreamChunkSource", "DeltaChunkSource", "BoostCheckpoint",
+           "checkpointed_fit", "checkpointed_warm_start", "CanaryGate",
+           "ContinuousTrainer"]

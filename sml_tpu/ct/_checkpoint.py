@@ -1,0 +1,261 @@
+"""Round-level boost checkpoints: interrupted fits resume mid-boost.
+
+A continuous trainer's refits run unattended; a preemption (or a crash,
+or a driver restart) mid-fit must cost the rounds since the last
+dispatch boundary, not the whole fit. `BoostCheckpoint` persists the
+partial ensemble after every `roundsPerDispatch` dispatch (via the
+`on_rounds(t_done, new_trees, base)` hook threaded through
+`tree_impl._boost_rounds`), and `checkpointed_fit` wraps the chunked
+fit so a re-run of the same target loads the newest checkpoint and
+warm-starts the REMAINING rounds — the resumed model is bit-identical
+to the uninterrupted one (the appended rounds' sampling streams are
+round-indexed, and the margin replay is carry-exact; tests/test_ct.py
+pins both).
+
+Layout (atomic by construction — the pointer file commits last):
+
+    <dir>/rounds-<t>/        partial `_EnsembleSpec.save` payload
+    <dir>/LATEST.json        {"t": t, "path": "rounds-<t>", ...meta}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils.profiler import PROFILER
+
+_LATEST = "LATEST.json"
+
+#: warm-start fit parameters a checkpoint must carry for resume to
+#: re-enter the identical program (seed rides separately)
+_RESUME_PARAMS = ("step_size", "subsample", "min_instances",
+                  "min_info_gain", "reg_lambda", "gamma", "loss")
+
+
+def _meta_match(saved: dict, want: dict, keys) -> bool:
+    """A checkpoint is only resumable by the fit that wrote it: mode,
+    target, seed, and the resume params must all agree — a stale or
+    foreign checkpoint (a different refit's, a different target's) is
+    cleared and the fit starts clean rather than silently returning a
+    half-finished ensemble of the wrong shape."""
+    return all(saved.get(k) == want.get(k) for k in keys)
+
+
+class BoostCheckpoint:
+    """One fit's checkpoint directory. `save()` is called from the fit
+    thread at dispatch boundaries; `load()`/`clear()` from the trainer.
+    Writes are tmp+rename (the partial-spec dir lands fully before the
+    LATEST pointer swings to it), so a kill mid-save leaves the previous
+    checkpoint intact."""
+
+    def __init__(self, directory: str, keep: int = 2):
+        self._dir = directory
+        self._keep = max(int(keep), 1)
+        self._lock = threading.Lock()
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def save(self, partial_spec, t_done: int, meta: dict) -> None:
+        """Persist the partial ensemble after global round `t_done`.
+        `meta` carries everything resume needs (n_target, seed, and the
+        `_RESUME_PARAMS` of the warm-start path)."""
+        with self._lock:
+            os.makedirs(self._dir, exist_ok=True)
+            rel = f"rounds-{int(t_done)}"
+            tmp = os.path.join(self._dir, rel + ".tmp")
+            final = os.path.join(self._dir, rel)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+            partial_spec.save(tmp)
+            shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            pointer = dict(meta)
+            pointer.update({"t": int(t_done), "path": rel})
+            ptmp = os.path.join(self._dir, _LATEST + ".tmp")
+            with open(ptmp, "w") as fh:
+                json.dump(pointer, fh)
+            os.replace(ptmp, os.path.join(self._dir, _LATEST))
+            PROFILER.count("ct.checkpoints")
+            self._prune(keep_rel=rel)
+
+    def _prune(self, keep_rel: str) -> None:
+        rounds = sorted(
+            (d for d in os.listdir(self._dir) if d.startswith("rounds-")
+             and not d.endswith(".tmp")),
+            key=lambda d: int(d.split("-", 1)[1]))
+        for d in rounds[:-self._keep]:
+            if d != keep_rel:
+                shutil.rmtree(os.path.join(self._dir, d),
+                              ignore_errors=True)
+
+    def load(self):
+        """(partial _EnsembleSpec, meta) of the newest committed
+        checkpoint, or None when the directory holds none."""
+        from ..ml._tree_models import _EnsembleSpec
+        with self._lock:
+            try:
+                with open(os.path.join(self._dir, _LATEST)) as fh:
+                    pointer = json.load(fh)
+            except (OSError, ValueError):
+                return None
+            path = os.path.join(self._dir, pointer["path"])
+            if not os.path.isdir(path):
+                return None
+            return _EnsembleSpec.load(path), pointer
+
+    def clear(self) -> None:
+        with self._lock:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+
+def _snapshot_spec(trees, step_size: float, depth: int, binning, base,
+                   n_features: int, mode: str):
+    from ..ml._tree_models import _EnsembleSpec
+    w = np.full(len(trees), float(step_size), dtype=np.float32)
+    return _EnsembleSpec(list(trees), depth, binning, w, float(base),
+                         n_features, mode)
+
+
+def checkpointed_warm_start(spec, source, checkpoint_dir: str, *,
+                            n_new_trees: int, seed: int = 17,
+                            sketch=None, **resume_kwargs):
+    """`warm_start_ensemble_chunked` with round-level checkpoints: a
+    preempted warm refit resumes from the last dispatch boundary and
+    finishes bit-identical to the uninterrupted append (the partial
+    ensemble IS a valid warm-start seed — appending the remaining
+    rounds re-enters the same round-indexed streams). The checkpoint
+    carries mode="warm" + (target, seed, params), and only a matching
+    re-run resumes it; anything else clears it, so a stale warm
+    checkpoint can never leak into a later full refit (and vice
+    versa — `checkpointed_fit` applies the same guard)."""
+    from ..ml._chunked import warm_start_ensemble_chunked
+    ck = BoostCheckpoint(checkpoint_dir)
+    step = float(resume_kwargs["step_size"]
+                 if resume_kwargs.get("step_size") is not None
+                 else spec.tree_weights[0])
+    n_target = len(spec.trees) + int(n_new_trees)
+    meta = {"mode": "warm", "n_target": n_target, "seed": int(seed),
+            "step_size": step,
+            "subsample": float(resume_kwargs.get("subsample", 1.0)),
+            "loss": resume_kwargs.get("loss")
+            or ("logistic" if spec.mode == "binary" else "squared")}
+    start, remaining = spec, int(n_new_trees)
+    resume = ck.load()
+    if resume is not None:
+        partial, saved = resume
+        if _meta_match(saved, meta, ("mode", "n_target", "seed",
+                                     "step_size", "subsample", "loss")) \
+                and len(spec.trees) < len(partial.trees) <= n_target:
+            PROFILER.count("ct.resumes")
+            start, remaining = partial, n_target - len(partial.trees)
+        else:
+            ck.clear()  # foreign/stale: start the append clean
+
+    def hook(t_done, new_trees, base):
+        snap = _snapshot_spec(list(start.trees) + list(new_trees), step,
+                              spec.depth, spec.binning, base,
+                              spec.n_features, spec.mode)
+        ck.save(snap, t_done, meta)
+
+    out = warm_start_ensemble_chunked(
+        start, source, n_new_trees=remaining, seed=seed, sketch=sketch,
+        on_rounds=hook, **resume_kwargs)
+    ck.clear()
+    return out
+
+
+def checkpointed_fit(source, checkpoint_dir: str, *, n_trees: int,
+                     max_depth: int, max_bins: int, seed: int = 17,
+                     categorical=None, loss: str = "squared",
+                     step_size: float = 0.1, subsample: float = 1.0,
+                     min_instances: int = 1, min_info_gain: float = 0.0,
+                     reg_lambda: float = 0.0, gamma: float = 0.0,
+                     rounds_per_dispatch: Optional[int] = None,
+                     drift_baseline=None, sketch=None):
+    """A chunked boosting fit that survives interruption: every dispatch
+    boundary checkpoints the partial ensemble (pass `rounds_per_dispatch`
+    to set the boundary spacing — one monolithic dispatch has no
+    boundaries to checkpoint at), and a re-run with the same
+    `checkpoint_dir` + source warm-starts the remaining rounds from the
+    newest checkpoint instead of refitting round 0 — but ONLY when the
+    checkpoint's (mode, target, seed, params) match this request; a
+    foreign or stale checkpoint is cleared, never resumed into the
+    wrong fit. Returns the finished `_EnsembleSpec` (checkpoints are
+    cleared on success). Restartability contract: the resumed model is
+    bit-identical to the uninterrupted fit of the same (source, params,
+    seed). `sketch` — a caller-provided pass-1 sketch of the same
+    window — saves one streaming pass (see `ingest_source`)."""
+    from ..ml._chunked import ingest_source, warm_start_ensemble_chunked
+    from ..ml._tree_models import _fit_ensemble
+
+    ck = BoostCheckpoint(checkpoint_dir)
+    meta = {"mode": "fresh", "n_target": int(n_trees), "seed": int(seed),
+            "step_size": float(step_size), "subsample": float(subsample),
+            "min_instances": int(min_instances),
+            "min_info_gain": float(min_info_gain),
+            "reg_lambda": float(reg_lambda), "gamma": float(gamma),
+            "loss": loss, "rounds_per_dispatch": rounds_per_dispatch}
+    resume = ck.load()
+    if resume is not None:
+        partial, saved = resume
+        if not _meta_match(saved, meta,
+                           ("mode", "n_target", "seed") + _RESUME_PARAMS):
+            ck.clear()   # foreign checkpoint (a warm refit's, or a
+            resume = None  # different target's): never poison this fit
+    if resume is not None:
+        partial, saved = resume
+        PROFILER.count("ct.resumes")
+        remaining = int(saved["n_target"]) - len(partial.trees)
+        if remaining <= 0:
+            ck.clear()
+            return partial
+
+        def warm_hook(t_done, new_trees, base):
+            snap = _snapshot_spec(
+                list(partial.trees) + list(new_trees),
+                float(saved["step_size"]), partial.depth, partial.binning,
+                base, partial.n_features, partial.mode)
+            ck.save(snap, t_done, saved)
+
+        spec = warm_start_ensemble_chunked(
+            partial, source, n_new_trees=remaining,
+            seed=int(saved["seed"]), on_rounds=warm_hook, sketch=sketch,
+            rounds_per_dispatch=saved.get("rounds_per_dispatch"),
+            **{k: saved[k] for k in _RESUME_PARAMS})
+        ck.clear()
+        return spec
+
+    # fresh fit: ingest once (the pass-1 sketch doubles as the model's
+    # drift baseline), then the ordinary prebinned fit with a hook that
+    # snapshots (trees-so-far, the fit's base, the ingest's binning)
+    mode = "binary" if loss == "logistic" else "regression"
+    categorical = categorical or {}
+    ing = ingest_source(source, max_bins, categorical, label="ct_fit",
+                        drift_baseline=drift_baseline, sketch=sketch)
+    if ing.y is None:
+        raise ValueError("checkpointed_fit needs a labeled ChunkSource")
+
+    def fresh_hook(t_done, trees_so_far, base):
+        snap = _snapshot_spec(trees_so_far, step_size, max_depth,
+                              ing.binning, base, source.n_features, mode)
+        ck.save(snap, t_done, meta)
+
+    spec = _fit_ensemble(
+        None, ing.y, categorical=categorical, max_depth=max_depth,
+        max_bins=max_bins, min_instances=min_instances,
+        min_info_gain=min_info_gain, n_trees=n_trees, feature_k=None,
+        bootstrap=False, subsample=subsample, seed=seed, loss=loss,
+        step_size=step_size, reg_lambda=reg_lambda, gamma=gamma,
+        boosting=True, rounds_per_dispatch=rounds_per_dispatch,
+        prebinned=(ing.binned, ing.binning), baseline_sketch=ing.sketch,
+        on_rounds=fresh_hook)
+    ck.clear()
+    return spec

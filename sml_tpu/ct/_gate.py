@@ -1,0 +1,126 @@
+"""Canary-gated promotion: a candidate earns Production, it is not given.
+
+The serving layer already owns the mechanism: a Staging version mirrors
+a deterministic fraction of live endpoint traffic off the request path
+(`sml.serve.canaryFraction`, `ServingEndpoint._mirror`) and accumulates
+prediction-divergence stats with worst-request exemplars. This module
+adds the JUDGMENT: drive the fresh window through the endpoint as gate
+traffic, wait for the mirror quorum, and promote only when every check
+clears — otherwise the candidate rolls back to Archived and a black-box
+bundle records why.
+
+Checks (all must pass; the gate FAILS CLOSED on an unobservable canary):
+
+- `mirrored`:   >= sml.ct.canaryMinMirrored shadow scores accumulated
+                inside sml.ct.gateTimeoutSec;
+- `errors`:     zero new canary-shadow errors AND zero request errors
+                while the gate drove traffic;
+- `divergence`: the mirrored |candidate - incumbent| stats are finite
+                (a NaN-scoring candidate must never promote);
+- `quality`:    candidate RMSE on the labeled gate window <=
+                incumbent RMSE x sml.ct.gateQualityTol (a
+                drift-triggered refit should WIN on drifted data).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+from ..utils.profiler import PROFILER, wallclock
+
+
+def _rmse(spec, X: np.ndarray, y: np.ndarray) -> float:
+    pred = spec.predict_margin(np.asarray(X, dtype=np.float64))
+    d = pred - np.asarray(y, dtype=np.float64)
+    return float(np.sqrt(d @ d / max(d.size, 1)))
+
+
+class CanaryGate:
+    """Promotion judge for one candidate window. Thresholds default to
+    the `sml.ct.*` conf keys; construct with overrides for tests."""
+
+    def __init__(self, min_mirrored: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 quality_tol: Optional[float] = None,
+                 batch_rows: int = 256):
+        self._min_mirrored = (
+            int(min_mirrored) if min_mirrored is not None
+            else GLOBAL_CONF.getInt("sml.ct.canaryMinMirrored"))
+        self._timeout_s = (
+            float(timeout_s) if timeout_s is not None
+            else float(GLOBAL_CONF.get("sml.ct.gateTimeoutSec")))
+        self._quality_tol = (
+            float(quality_tol) if quality_tol is not None
+            else float(GLOBAL_CONF.get("sml.ct.gateQualityTol")))
+        self._batch_rows = max(int(batch_rows), 1)
+
+    def run(self, endpoint, X: np.ndarray, y: Optional[np.ndarray],
+            candidate_spec, incumbent_spec) -> Dict[str, object]:
+        """Judge `candidate_spec` (already holding Staging) against
+        `incumbent_spec` (holding Production) over the (X, y) gate
+        window. With an endpoint, the window replays as live traffic so
+        the canary mirror observes the candidate in the serving path;
+        without one (no live endpoint yet), mirror checks are skipped
+        and the verdict rests on the quality bar alone."""
+        X = np.asarray(X)
+        checks: Dict[str, bool] = {}
+        out: Dict[str, object] = {"rows": int(X.shape[0])}
+        request_errors = 0
+        if endpoint is not None:
+            stats0 = endpoint.canary_stats()
+            for lo in range(0, X.shape[0], self._batch_rows):
+                try:
+                    endpoint.score(X[lo:lo + self._batch_rows],
+                                   timeout=30.0)
+                except Exception:  # noqa: BLE001 — a failed request is a
+                    request_errors += 1  # gate verdict, not a crash
+            t0 = wallclock()
+            while True:
+                stats = endpoint.canary_stats()
+                mirrored = stats["mirrored"] - stats0["mirrored"]
+                if mirrored >= self._min_mirrored:
+                    break
+                if wallclock() - t0 > self._timeout_s:
+                    break
+                time.sleep(0.02)
+            canary_errors = stats["errors"] - stats0["errors"]
+            checks["mirrored"] = bool(mirrored >= self._min_mirrored)
+            checks["errors"] = bool(canary_errors == 0
+                                    and request_errors == 0)
+            # judge the MEAN too: the endpoint folds max via Python
+            # max() against a finite 0.0, which silently drops NaN —
+            # the running sum (and so the mean) is the stat a
+            # NaN-scoring candidate cannot hide from
+            checks["divergence"] = bool(
+                math.isfinite(float(stats["max_abs_diff"]))
+                and math.isfinite(float(stats["mean_abs_diff"])))
+            out.update({
+                "mirrored": int(mirrored),
+                "canary_errors": int(canary_errors),
+                "request_errors": int(request_errors),
+                "mean_abs_diff": float(stats["mean_abs_diff"]),
+                "max_abs_diff": float(stats["max_abs_diff"]),
+            })
+        if y is not None and candidate_spec is not None \
+                and incumbent_spec is not None:
+            rmse_cand = _rmse(candidate_spec, X, y)
+            rmse_inc = _rmse(incumbent_spec, X, y)
+            checks["quality"] = bool(
+                math.isfinite(rmse_cand)
+                and rmse_cand <= rmse_inc * self._quality_tol)
+            out.update({"rmse_candidate": round(rmse_cand, 6),
+                        "rmse_incumbent": round(rmse_inc, 6),
+                        "quality_tol": self._quality_tol})
+        passed = bool(checks) and all(checks.values())
+        out["checks"] = checks
+        out["passed"] = passed
+        if passed:
+            PROFILER.count("ct.gate_pass")
+        else:
+            PROFILER.count("ct.gate_fail")
+        return out

@@ -1,0 +1,151 @@
+"""Live ChunkSources: streaming/Delta micro-batches as out-of-core chunks.
+
+The PR-10 data plane defined `ChunkSource` as a RE-ITERABLE protocol
+(the streamed quantization is a two-pass fit), while live sources grow
+between passes. These adapters square that circle with an explicit
+watermark discipline:
+
+- `snapshot()` freezes the data committed SINCE the watermark as the
+  source's window — both ingest passes stream exactly that window;
+- `advance()` moves the watermark past the frozen window once it has
+  been consumed (a fit landed, or the trainer decided to skip it);
+- everything before the watermark is never re-read: each micro-batch
+  pays only its own sketch/quantize/H2D pass, which is what makes the
+  continuous-training loop incremental rather than
+  refit-the-world-per-trigger.
+
+`fingerprint()` is None for the stream adapter (a live window must
+never satisfy an ingest from the memo) and version-range-keyed for the
+Delta adapter (a frozen version range IS content-stable).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..frame._chunks import ChunkSource
+
+
+class StreamChunkSource(ChunkSource):
+    """A memory-sink `StreamingQuery`'s committed micro-batches as
+    chunks. The query's trigger thread appends each processed batch to
+    its memory buffer; `snapshot()` freezes the batches committed since
+    the watermark (holding references, so later appends never mutate
+    the window) and `_iter_chunks` re-streams them in commit order,
+    split to `chunk_rows`-row blocks."""
+
+    def __init__(self, query, feature_cols: Sequence[str],
+                 label_col: Optional[str] = None,
+                 chunk_rows: Optional[int] = None):
+        fmt = getattr(query, "_fmt", None)
+        if fmt != "memory":
+            raise ValueError(
+                f"StreamChunkSource adapts a memory-sink StreamingQuery "
+                f"(got sink format {fmt!r}); point Delta/parquet sinks "
+                f"at DeltaChunkSource or a file source instead")
+        self._query = query
+        self._features = list(feature_cols)
+        self._label = label_col
+        self._chunk_rows = int(chunk_rows) if chunk_rows else None
+        self.n_features = len(self._features)
+        self._lo = 0            # micro-batches consumed (watermark)
+        self._hi = 0            # end of the frozen window
+        self._window: List = []
+        self.n_rows = 0
+
+    def snapshot(self) -> int:
+        """Freeze the micro-batches committed since the watermark as
+        the window; returns its row count. CPython list append is
+        atomic, so slicing under the captured length races nothing."""
+        parts = self._query._mem_parts
+        hi = len(parts)
+        self._window = parts[self._lo:hi]
+        self._hi = hi
+        self.n_rows = int(sum(len(p) for p in self._window))
+        return self.n_rows
+
+    def advance(self) -> None:
+        """Consume the frozen window: the watermark moves past it and
+        the next `snapshot()` sees only newer micro-batches."""
+        self._lo = self._hi
+        self._window = []
+        self.n_rows = 0
+
+    def _iter_chunks(self):
+        c = self.chunk_rows
+        for p in self._window:
+            for start in range(0, len(p), c):
+                g = p.iloc[start:start + c]
+                X = g[self._features].to_numpy(dtype=np.float64)
+                y = (g[self._label].to_numpy(dtype=np.float64)
+                     if self._label is not None else None)
+                yield X, y
+
+    def fingerprint(self):
+        return None  # live window: never serve an ingest from the memo
+
+
+class DeltaChunkSource(ChunkSource):
+    """New Delta versions since a watermark as chunks: `snapshot()`
+    freezes the add-file actions of every commit past the consumed
+    version (row counts come from the log's `numRecords`, so the window
+    size is known without touching a parquet file), `_iter_chunks`
+    streams each added file in commit order. Append-mode tables are the
+    contract — an overwrite rewrites history, which a consumed
+    watermark cannot describe."""
+
+    def __init__(self, path: str, feature_cols: Sequence[str],
+                 label_col: Optional[str] = None,
+                 chunk_rows: Optional[int] = None,
+                 start_version: int = -1):
+        self._path = path
+        self._features = list(feature_cols)
+        self._label = label_col
+        self._chunk_rows = int(chunk_rows) if chunk_rows else None
+        self.n_features = len(self._features)
+        self._since = int(start_version)   # highest consumed version
+        self._snap_hi = self._since
+        self._snap_files: List[str] = []
+        self.n_rows = 0
+
+    def snapshot(self) -> int:
+        from ..delta.table import _list_versions, _read_commit
+        versions = [v for v in _list_versions(self._path)
+                    if v > self._since]
+        files: List[str] = []
+        n = 0
+        for v in sorted(versions):
+            for action in _read_commit(self._path, v):
+                if "add" in action:
+                    files.append(action["add"]["path"])
+                    n += int(action["add"].get("numRecords", 0))
+        self._snap_files = files
+        self._snap_hi = max(versions) if versions else self._since
+        self.n_rows = n
+        return n
+
+    def advance(self) -> None:
+        self._since = self._snap_hi
+        self._snap_files = []
+        self.n_rows = 0
+
+    def _iter_chunks(self):
+        import pyarrow.parquet as pq
+        c = self.chunk_rows
+        for rel in self._snap_files:
+            pdf = pq.read_table(os.path.join(self._path, rel)).to_pandas()
+            for start in range(0, len(pdf), c):
+                g = pdf.iloc[start:start + c]
+                X = g[self._features].to_numpy(dtype=np.float64)
+                y = (g[self._label].to_numpy(dtype=np.float64)
+                     if self._label is not None else None)
+                yield X, y
+
+    def fingerprint(self):
+        # a frozen version window is content-stable: commits are
+        # immutable once written, so (path, range, files) keys reuse
+        return ("delta-window", self._path, self._since, self._snap_hi,
+                tuple(self._snap_files))

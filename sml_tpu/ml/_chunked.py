@@ -40,7 +40,7 @@ from ..conf import GLOBAL_CONF
 from ..frame._chunks import ChunkSource, DatasetSketch, FoldChunkSource
 from ..parallel import mesh as meshlib
 from ..utils.profiler import PROFILER, now
-from .tree_impl import Binning, _bin_columns
+from .tree_impl import Binning, _bin_columns, binning_edges_and_dtype
 
 
 class IngestResult(NamedTuple):
@@ -95,7 +95,8 @@ def sketch_source(source: ChunkSource, max_bins: int,
 def ingest_source(source: ChunkSource, max_bins: int,
                   categorical: Optional[Dict[int, int]] = None,
                   label: str = "source",
-                  drift_baseline=None) -> IngestResult:
+                  drift_baseline=None, binning: Binning = None,
+                  sketch: Optional[DatasetSketch] = None) -> IngestResult:
     """Two-pass streamed quantization of a ChunkSource into the engine's
     compact bin representation (module docstring has the pipeline
     shape). Returns the host mirror + binning with the assembled device
@@ -108,11 +109,22 @@ def ingest_source(source: ChunkSource, max_bins: int,
     "ingest" in `engine_health()["drift"]` — the refit-trigger signal
     for continuous training. The "ingest" slot is LAST-WINS (the block
     reflects the most recent monitored ingest; its `idle_s` field marks
-    how stale the verdicts are)."""
+    how stale the verdicts are).
+
+    `binning` pins the quantization to a SAVED model's edges/remaps
+    instead of finalizing fresh ones from the pass-1 sketch — the
+    warm-start ingest shape (`warm_start_ensemble_chunked`): appended
+    boosting rounds must split on the bin ids the saved trees
+    reference, so fresh micro-batches quantize under the old edges.
+    Pass 1 still streams (row count, the refreshed model's own
+    baseline sketch, the optional drift monitor ride along free)."""
     # a monitored ingest is a MONITORING PASS: it must actually stream
     # the chunks against the caller's baseline, never be satisfied by a
-    # cached result (and never poison the cache for unmonitored reuse)
-    key = None if drift_baseline is not None \
+    # cached result (and never poison the cache for unmonitored reuse);
+    # binning-pinned and caller-sketched ingests skip the memo too —
+    # their result depends on caller state the fingerprint cannot see
+    key = None if (drift_baseline is not None or binning is not None
+                   or sketch is not None) \
         else _memo_key(source, max_bins, categorical)
     hit = _ingest_memo.get(key) if key is not None else None
     if hit is not None:
@@ -125,10 +137,18 @@ def ingest_source(source: ChunkSource, max_bins: int,
         monitor = _driftmod.DriftMonitor(drift_baseline, name="ingest")
         _driftmod.DRIFT.register("ingest", monitor)
 
-    # ---- pass 1: streamed sketch (counts rows, learns edges)
+    # ---- pass 1: streamed sketch (counts rows, learns edges). A
+    # caller-provided `sketch` of the SAME frozen window (the trainer's
+    # judgment pass already streamed one) substitutes for the pass —
+    # but never when a monitor must stream (monitoring is per-chunk)
     t0 = now()
-    sketch = sketch_source(source, max_bins, categorical, monitor=monitor)
-    binning, edge_list, out_dtype = sketch.to_binning(max_bins)
+    if sketch is None or monitor is not None:
+        sketch = sketch_source(source, max_bins, categorical,
+                               monitor=monitor)
+    if binning is not None:
+        edge_list, out_dtype = binning_edges_and_dtype(binning)
+    else:
+        binning, edge_list, out_dtype = sketch.to_binning(max_bins)
     n = sketch.n_rows
     sketch_s = now() - t0
     PROFILER.count("ingest.sketch_compress", float(sum(
@@ -267,7 +287,8 @@ def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
                          step_size: float = 0.1, reg_lambda: float = 0.0,
                          gamma: float = 0.0, boosting: bool = False,
                          rounds_per_dispatch: Optional[int] = None,
-                         drift_baseline=None):
+                         drift_baseline=None, on_rounds=None,
+                         sketch=None):
     """Tree-ensemble fit end-to-end from a ChunkSource: streamed
     quantization, then the ordinary `_fit_ensemble` over the prebinned
     compact matrix — the raw float data is never resident whole on host
@@ -277,7 +298,7 @@ def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
     against a PRIOR model's baseline (see `ingest_source`)."""
     from ._tree_models import _fit_ensemble
     ing = ingest_source(source, max_bins, categorical, label="fit",
-                        drift_baseline=drift_baseline)
+                        drift_baseline=drift_baseline, sketch=sketch)
     if ing.y is None:
         raise ValueError("fit_ensemble_chunked needs a labeled ChunkSource "
                          "(chunks must yield (X, y) with y not None)")
@@ -288,7 +309,47 @@ def fit_ensemble_chunked(source: ChunkSource, *, categorical=None,
         bootstrap=bootstrap, subsample=subsample, seed=seed, loss=loss,
         step_size=step_size, reg_lambda=reg_lambda, gamma=gamma,
         boosting=boosting, rounds_per_dispatch=rounds_per_dispatch,
-        prebinned=(ing.binned, ing.binning), baseline_sketch=ing.sketch)
+        prebinned=(ing.binned, ing.binning), baseline_sketch=ing.sketch,
+        on_rounds=on_rounds)
+
+
+def warm_start_ensemble_chunked(spec, source: ChunkSource, *,
+                                n_new_trees: int, seed: int = 17,
+                                drift_baseline=None, sketch=None,
+                                **resume_kwargs):
+    """Warm-start incremental boosting from a ChunkSource: fresh chunks
+    quantize under the SAVED spec's binning (appended rounds must split
+    on the bin ids the saved trees reference — `ingest_source(binning=)`
+    pins the edges), pass 1's sketch doubles as the refreshed model's
+    drift baseline and feeds the optional per-chunk ingest monitor
+    (`drift_baseline=` — the continuous-training refit loop's signal),
+    pass 2 assembles through the same double-buffered prefetch, then the
+    saved rounds' margin replays on device and `n_new_trees` rounds
+    append via the staged `roundsPerDispatch` dispatch. k rounds +
+    warm-start (N-k) rounds == an N-round fit bit-identically on the
+    same data/seed; `resume_kwargs` mirror `warm_start_ensemble`'s
+    (subsample, step_size, feature_k, rounds_per_dispatch, on_rounds —
+    the round-level checkpoint hook). `sketch` is a caller-provided
+    pass-1 sketch of the SAME frozen window (the continuous trainer's
+    judgment pass already streamed one — reusing it saves a full read
+    of the window)."""
+    from ._tree_models import _resume_ensemble
+    if spec.tree_weights is None:
+        raise ValueError(
+            "warm start needs a boosted spec (GBT/xgboost): forest/DT "
+            "trees average independent rounds — refit those whole")
+    categorical = {f: len(r) for f, r in spec.binning.cat_remap.items()}
+    max_bins = spec.binning.edges.shape[1] + 1
+    ing = ingest_source(source, max_bins, categorical, label="warm_fit",
+                        drift_baseline=drift_baseline,
+                        binning=spec.binning, sketch=sketch)
+    if ing.y is None:
+        raise ValueError("warm_start_ensemble_chunked needs a labeled "
+                         "ChunkSource (chunks must yield (X, y) with y "
+                         "not None)")
+    return _resume_ensemble(spec, ing.binned, ing.y,
+                            n_new_trees=n_new_trees, seed=seed,
+                            baseline_sketch=ing.sketch, **resume_kwargs)
 
 
 def iter_predictions(spec, source: ChunkSource):

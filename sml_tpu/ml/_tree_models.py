@@ -236,7 +236,8 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
                   gamma: float = 0.0, boosting: bool = False,
                   missing: Optional[float] = None,
                   rounds_per_dispatch: Optional[int] = None,
-                  prebinned=None, baseline_sketch=None) -> _EnsembleSpec:
+                  prebinned=None, baseline_sketch=None,
+                  on_rounds=None) -> _EnsembleSpec:
     """The one training path behind every tree learner: bin on host, then
     the WHOLE forest/boosting fit runs as a single on-device program
     (`tree_impl.fit_ensemble_on_device`).
@@ -281,7 +282,7 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
         y_dev = stage_aligned(y32, staged.n_padded)
         trees, base = tree_impl.fit_ensemble_on_device(
             staged.binned_dev, y_dev, staged.mask_dev, es, seed=seed,
-            rounds_per_dispatch=rounds_per_dispatch)
+            rounds_per_dispatch=rounds_per_dispatch, on_rounds=on_rounds)
     mode = "binary" if loss == "logistic" else "regression"
     if boosting:
         weights = np.full(len(trees), step_size, dtype=np.float32)
@@ -299,6 +300,95 @@ def _fit_ensemble(X: np.ndarray, y: np.ndarray, *, categorical: Dict[int, int],
     spec.baseline = _drift.capture_fit_baseline(
         X, y32, categorical, spec, binned=binned, sketch=baseline_sketch)
     return spec
+
+
+def _resume_ensemble(spec: _EnsembleSpec, binned: np.ndarray,
+                     y32: np.ndarray, *, n_new_trees: int, seed: int,
+                     feature_k: Optional[int] = None, min_instances: int = 1,
+                     min_info_gain: float = 0.0, reg_lambda: float = 0.0,
+                     gamma: float = 0.0, subsample: float = 1.0,
+                     bootstrap: bool = False,
+                     step_size: Optional[float] = None,
+                     loss: Optional[str] = None,
+                     rounds_per_dispatch: Optional[int] = None,
+                     X: Optional[np.ndarray] = None, baseline_sketch=None,
+                     on_rounds=None) -> _EnsembleSpec:
+    """Warm-start core shared by the monolithic (`warm_start_ensemble`)
+    and chunked (`ml/_chunked.warm_start_ensemble_chunked`) paths: stage
+    the matrix ALREADY QUANTIZED under the saved spec's binning (the
+    appended rounds must split on the bin ids the saved trees
+    reference), replay the saved rounds' margin on device, and append
+    `n_new_trees` boosting rounds through the same staged dispatch a
+    fresh fit uses. Round t of the combined ensemble draws the same
+    sampling/feature stream whether it was fitted monolithically or
+    appended later (the fold_in(t) streams are round-indexed), so k
+    rounds + warm-start (N-k) rounds == N rounds bit-identically on the
+    same data/seed (tests/test_ct.py pins it)."""
+    if spec.tree_weights is None:
+        raise ValueError(
+            "warm start needs a boosted spec (GBT/xgboost): forest/DT "
+            "trees average independent rounds — refit those whole")
+    saved_step = float(spec.tree_weights[0])
+    step = float(step_size) if step_size is not None else saved_step
+    if np.float32(step) != np.float32(saved_step):
+        # the margin replay and the combined weight vector both apply
+        # ONE step to every round: a different step would silently
+        # rescale the SAVED rounds' contribution, changing the
+        # incumbent's predictions retroactively
+        raise ValueError(
+            f"warm start cannot change step_size: the saved rounds were "
+            f"fitted at {saved_step} (got {step}); refit full to move it")
+    loss = loss or ("logistic" if spec.mode == "binary" else "squared")
+    F = spec.n_features
+    max_bins = spec.binning.edges.shape[1] + 1
+    n_total = len(spec.trees) + int(n_new_trees)
+    from ._staging import routed_for
+    hint = dispatch.WorkHint(
+        flops=2.0 * n_new_trees * spec.depth * binned.shape[0] * F
+        * max_bins, kind="scatter")
+    with routed_for(hint, binned):
+        staged = stage_tree_data(X, y32, max_bins, None,
+                                 prebinned=(binned, spec.binning))
+        tspec = TreeSpec(max_depth=spec.depth, n_bins=max_bins,
+                         n_features=F, feature_k=feature_k or F,
+                         min_instances=min_instances,
+                         min_info_gain=min_info_gain,
+                         reg_lambda=reg_lambda, gamma=gamma)
+        es = tree_impl.EnsembleSpec(
+            tree=tspec, n_trees=n_total, loss=loss, boosting=True,
+            bootstrap=bool(bootstrap) and n_total > 1,
+            subsample=float(subsample), step_size=step)
+        y_dev = stage_aligned(y32, staged.n_padded)
+        new_trees, base = tree_impl.resume_ensemble_on_device(
+            staged.binned_dev, y_dev, staged.mask_dev, es, seed=seed,
+            init_trees=spec.trees, base=float(spec.base),
+            rounds_per_dispatch=rounds_per_dispatch, on_rounds=on_rounds)
+    trees = list(spec.trees) + list(new_trees)
+    weights = np.full(len(trees), step, dtype=np.float32)
+    out = _EnsembleSpec(trees, spec.depth, spec.binning, weights,
+                        float(spec.base), F, spec.mode)
+    categorical = {f: len(r) for f, r in spec.binning.cat_remap.items()}
+    from ..obs import drift as _drift
+    out.baseline = _drift.capture_fit_baseline(
+        X, y32, categorical, out, binned=binned, sketch=baseline_sketch)
+    return out
+
+
+def warm_start_ensemble(spec: _EnsembleSpec, X: np.ndarray, y: np.ndarray,
+                        *, n_new_trees: int, seed: int,
+                        **resume_kwargs) -> _EnsembleSpec:
+    """Resume a saved boosted `_EnsembleSpec` on in-memory (X, y):
+    quantize with the SAVED binning (`bin_with` — warm-started rounds
+    never move the bin edges) and append `n_new_trees` rounds. Keyword
+    knobs mirror `_fit_ensemble`'s (subsample, step_size, feature_k,
+    rounds_per_dispatch, ...); step_size/loss default to the saved
+    spec's. The out-of-core twin is
+    `ml/_chunked.warm_start_ensemble_chunked`."""
+    X = np.asarray(X)
+    y32 = np.asarray(y, np.float32)
+    binned = bin_with(X, spec.binning)
+    return _resume_ensemble(spec, binned, y32, n_new_trees=n_new_trees,
+                            seed=seed, X=X, **resume_kwargs)
 
 
 def _fit_ensemble_folds(Xs, ys, cats, *, max_depth: int, max_bins: int,

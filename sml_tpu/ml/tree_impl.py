@@ -225,6 +225,21 @@ _predict_bin_lock = _threading.Lock()  # CV trials bin concurrently
 # digitize per eval (r4 profile: 6.2s/pass)
 
 
+def binning_edges_and_dtype(binning: Binning):
+    """(edge_list, out_dtype) for quantizing FRESH rows under a saved
+    `Binning` — the one shared derivation behind predict-time `bin_with`
+    and the pinned-binning warm-start ingest (`ml/_chunked
+    .ingest_source(binning=)`), so the two can never drift: same
+    finite-edge extraction, same compact-dtype sizing over max_bins AND
+    every categorical cardinality (which may exceed max_bins when the
+    guard was suppressed at fit time)."""
+    edge_list = [binning.edges[f][np.isfinite(binning.edges[f])]
+                 for f in range(binning.edges.shape[0])]
+    need = max([binning.edges.shape[1] + 1]
+               + [len(r) for r in binning.cat_remap.values()])
+    return edge_list, bin_dtype(need)
+
+
 def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
     """Apply training-time bin edges / category ranks at predict time.
 
@@ -246,15 +261,8 @@ def bin_with(X: np.ndarray, binning: Binning) -> np.ndarray:
             _predict_bin_cache[key] = hit
     if hit is not None:
         return hit
-    edge_list = [binning.edges[f][np.isfinite(binning.edges[f])]
-                 for f in range(X.shape[1])]
-    # compact dtype keyed by the model's maxBins (edges carry B-1 slots)
-    # AND its categorical cardinalities (which may exceed maxBins when the
-    # guard was suppressed at fit time) — predict-time matrices ride the
-    # same quantized representation as fit, never wrapping a rank
-    need = max([binning.edges.shape[1] + 1]
-               + [len(r) for r in binning.cat_remap.values()])
-    out = _bin_columns(Xn, edge_list, binning.cat_remap, bin_dtype(need))
+    edge_list, out_dtype = binning_edges_and_dtype(binning)
+    out = _bin_columns(Xn, edge_list, binning.cat_remap, out_dtype)
     from ..conf import GLOBAL_CONF
     max_bytes = GLOBAL_CONF.getInt("sml.predict.binCacheBytes")
     with _predict_bin_lock:
@@ -841,9 +849,57 @@ def _compiled_chunk(es: EnsembleSpec, chunk: int,
     return _chunk_cache[key]
 
 
+def _boost_rounds(binned_dev, y_dev, mask_dev, es: EnsembleSpec, seed: int,
+                  chunk: int, kernel: str, margin, t0: int = 0,
+                  on_rounds=None):
+    """The staged boosting dispatch loop: rounds [t0, es.n_trees) in
+    ceil((n_trees - t0)/chunk) dispatches over a margin carry (donated
+    between chunks). Shared by the fresh chunked fit (t0=0, margin =
+    full(base)) and the warm-start resume (t0 = saved rounds, margin
+    replayed from the saved trees), so an appended round t runs the
+    exact program a fresh fit's round t would — the round index keys
+    the sampling/feature streams, not the dispatch position.
+
+    `on_rounds(t_done, new_trees)` fires after each non-final dispatch
+    with the rounds appended SO FAR (one extra packed D2H per dispatch
+    when set; the callers wrap in the fit's base as a third arg) — the
+    round-level checkpoint hook of the continuous-training plane
+    (sml_tpu/ct): an interrupted or preempted boost resumes from the
+    last dispatch boundary instead of restarting the fit."""
+    from ..parallel import prewarm as _prewarm
+    from ..utils.profiler import PROFILER
+    rng = jax.random.key_data(jax.random.PRNGKey(seed))
+    packs_parts = []   # no-hook path: device packs, ONE batched D2H at end
+    host_packs = []    # hook path: each pack fetched ONCE at its boundary
+    t = int(t0)
+    with transient_hbm("hist_onehot",
+                       _onehot_bytes(es.tree, binned_dev.shape[0], kernel)):
+        while t < es.n_trees:
+            c = min(chunk, es.n_trees - t)
+            _prewarm.record("tree_chunk", {
+                "es": _es_meta(es), "chunk": int(c), "kernel": kernel,
+                "kernel_rows": _kernel_block_rows(kernel),
+                "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev,
+                                           margin)})
+            PROFILER.count("tree.fit_dispatch")
+            margin, packs = _compiled_chunk(es, c, kernel)(
+                binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t))
+            t += c
+            if on_rounds is None:
+                packs_parts.append(packs)
+            else:
+                host_packs.append(np.asarray(jax.device_get(packs)))
+                if t < es.n_trees:
+                    on_rounds(t, _unpack_trees(
+                        np.concatenate(host_packs, axis=0)))
+        packs = (np.concatenate(host_packs, axis=0) if host_packs
+                 else np.concatenate(jax.device_get(packs_parts), axis=0))
+    return _unpack_trees(packs)
+
+
 def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                           seed: int, chunk: int,
-                          kernel: Optional[str] = None):
+                          kernel: Optional[str] = None, on_rounds=None):
     """Boosting rounds in ceil(n_trees/chunk) dispatches. The margin never
     visits the host between chunks — it carries as a donated device buffer
     — and per-chunk tree packs are fetched once at the end (one batched
@@ -864,37 +920,111 @@ def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
     margin_bytes = margin.nbytes
     LEDGER.alloc("boost_margin", margin_bytes)
     try:
-        from ..parallel import prewarm as _prewarm
-        from ..utils.profiler import PROFILER
-        rng = jax.random.key_data(jax.random.PRNGKey(seed))
-        packs_parts = []
-        t0 = 0
-        with transient_hbm("hist_onehot",
-                           _onehot_bytes(es.tree, binned_dev.shape[0], kernel)):
-            while t0 < es.n_trees:
-                c = min(chunk, es.n_trees - t0)
-                _prewarm.record("tree_chunk", {
-                    "es": _es_meta(es), "chunk": int(c), "kernel": kernel,
-                    "kernel_rows": _kernel_block_rows(kernel),
-                    "args": _prewarm.arg_specs(binned_dev, y_dev, mask_dev,
-                                               margin)})
-                PROFILER.count("tree.fit_dispatch")
-                margin, packs = _compiled_chunk(es, c, kernel)(
-                    binned_dev, y_dev, mask_dev, margin, rng, jnp.int32(t0))
-                packs_parts.append(packs)
-                t0 += c
-            packs = np.concatenate(jax.device_get(packs_parts), axis=0)
+        hook = None if on_rounds is None \
+            else (lambda t, tr: on_rounds(t, tr, base))
+        trees = _boost_rounds(binned_dev, y_dev, mask_dev, es, seed, chunk,
+                              kernel, margin, t0=0, on_rounds=hook)
     finally:
         LEDGER.free("boost_margin", margin_bytes)
-    return _unpack_trees(packs), base
+    return trees, base
+
+
+_margin_replay_cache: Dict[tuple, object] = {}
+
+
+def _margin_replay_compiled(depth: int, n_trees: int):
+    """Sharded device replay of a saved ensemble's boosting margin:
+    margin_0 = full(base); margin_{t+1} = margin_t + step * leaf_t(row)
+    — the SAME mul-then-add sequence (and scan shape) the fit program's
+    carry runs, so a warm start resumes from a margin bit-identical to
+    the one an uninterrupted fit would be carrying. Padding rows replay
+    too (their binned rows are the same zeros the fit traversed), so
+    the carry matches over the whole padded buffer."""
+    mesh = meshlib.get_mesh()
+    key = (int(depth), int(n_trees), id(mesh))
+    if key not in _margin_replay_cache:
+        from ..obs import note_compile
+        note_compile("tree_margin_replay")
+
+        def program(binned, sf, sb, lv, base, step):
+            binned32 = binned.astype(jnp.int32)
+            margin0 = jnp.full((binned.shape[0],), base, dtype=jnp.float32)
+
+            def round_fn(margin, t):
+                leaf = _traverse(binned32, sf[t], sb[t], lv[t], depth)
+                return margin + step * leaf, ()
+
+            margin, _ = jax.lax.scan(
+                round_fn, margin0, jnp.arange(n_trees, dtype=jnp.int32))
+            return margin
+
+        _margin_replay_cache[key] = data_parallel(
+            program, out_replicated=False,
+            replicated_argnums=(1, 2, 3, 4, 5))
+    return _margin_replay_cache[key]
+
+
+def resume_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
+                              seed: int, init_trees, base: float,
+                              rounds_per_dispatch: Optional[int] = None,
+                              on_rounds=None):
+    """Warm-start incremental boosting: append rounds len(init_trees)..
+    es.n_trees-1 to a saved ensemble. The saved rounds' margin replays
+    on device (`_margin_replay_compiled`), then the appended rounds run
+    through the SAME staged `roundsPerDispatch` dispatch as a fresh
+    chunked fit, with round indices offset so sampling streams and
+    feature subspaces match the monolithic scan round-for-round: k
+    rounds + warm-start (N-k) rounds == N rounds bit-identically on the
+    same data/seed (tests/test_ct.py). Returns (new_trees, base) — the
+    appended rounds only; the caller prepends the saved trees."""
+    from ..conf import GLOBAL_CONF
+    from ..parallel import dispatch as _dispatch
+    from ..utils.profiler import PROFILER
+    if not es.boosting:
+        raise ValueError("warm-start resume requires a boosting ensemble "
+                         "(forest/DT rounds are independent — refit whole)")
+    t0 = len(init_trees)
+    if es.n_trees <= t0:
+        return [], float(base)
+    kernel = _kernel_for(es.tree)
+    rounds = (rounds_per_dispatch if rounds_per_dispatch is not None
+              else GLOBAL_CONF.getInt("sml.tree.roundsPerDispatch"))
+    chunk = rounds if 0 < rounds else (es.n_trees - t0)
+    mesh = meshlib.get_mesh()
+    sf = np.stack([t.split_feature for t in init_trees])
+    sb = np.stack([t.split_bin for t in init_trees])
+    lv = np.stack([t.leaf_value for t in init_trees])
+    with PROFILER.span(
+            "program.tree_resume", rows=int(binned_dev.shape[0]),
+            route="host" if _dispatch.is_host_mesh(mesh) else "device",
+            trees=es.n_trees - t0):
+        margin = _margin_replay_compiled(es.tree.max_depth, t0)(
+            binned_dev, sf, sb, lv, np.float32(base),
+            np.float32(es.step_size))
+        from ..obs import LEDGER
+        margin_bytes = margin.nbytes
+        LEDGER.alloc("boost_margin", margin_bytes)
+        try:
+            hook = None if on_rounds is None \
+                else (lambda t, tr: on_rounds(t, tr, float(base)))
+            trees = _boost_rounds(binned_dev, y_dev, mask_dev, es, seed,
+                                  chunk, kernel, margin, t0=t0,
+                                  on_rounds=hook)
+        finally:
+            LEDGER.free("boost_margin", margin_bytes)
+    return trees, float(base)
 
 
 def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                            seed: int = 0,
-                           rounds_per_dispatch: Optional[int] = None):
+                           rounds_per_dispatch: Optional[int] = None,
+                           on_rounds=None):
     """Run the whole-ensemble program; returns (trees, base).
     `rounds_per_dispatch` overrides sml.tree.roundsPerDispatch (the
-    sparkdl.xgboost surface exposes it per-estimator)."""
+    sparkdl.xgboost surface exposes it per-estimator). `on_rounds` is
+    the round-level checkpoint hook (boosting only — it forces the
+    chunked dispatch path so the hook has dispatch boundaries to fire
+    at; see `_boost_rounds`)."""
     from ..parallel import dispatch as _dispatch
     from ..parallel import mesh as _meshlib
     from ..utils.profiler import PROFILER
@@ -903,7 +1033,7 @@ def fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
             route="host" if _dispatch.is_host_mesh(_meshlib.get_mesh())
             else "device", trees=es.n_trees):
         return _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es, seed,
-                                       rounds_per_dispatch)
+                                       rounds_per_dispatch, on_rounds)
 
 
 def _ensemble_compiled(es: EnsembleSpec, kernel: Optional[str] = None,
@@ -943,14 +1073,17 @@ def _onehot_bytes(spec: TreeSpec, rows: int, kernel: str) -> int:
 
 def _fit_ensemble_on_device(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
                             seed: int = 0,
-                            rounds_per_dispatch: Optional[int] = None):
+                            rounds_per_dispatch: Optional[int] = None,
+                            on_rounds=None):
     from ..conf import GLOBAL_CONF
     kernel = _kernel_for(es.tree)
     rounds = (rounds_per_dispatch if rounds_per_dispatch is not None
               else GLOBAL_CONF.getInt("sml.tree.roundsPerDispatch"))
-    if es.boosting and 0 < rounds < es.n_trees:
+    if es.boosting and (0 < rounds < es.n_trees or on_rounds is not None):
         return _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es,
-                                     seed, rounds, kernel)
+                                     seed, rounds if 0 < rounds
+                                     else es.n_trees, kernel,
+                                     on_rounds=on_rounds)
     compiled = _ensemble_compiled(es, kernel)
     rng = jax.random.key_data(jax.random.PRNGKey(seed))
     from ..parallel import prewarm as _prewarm

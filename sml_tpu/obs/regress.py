@@ -22,6 +22,13 @@ didn't eyeball PERF.md closely enough. `compare()` is the machine check:
   generous 50%;
 - **coverage**: a leg present in the base but missing from the
   candidate is itself a regression (silent coverage loss);
+- **continuous-training proofs**: the sidecar `ct` block's closed-loop
+  promotion proof (drifting stream → warm-start refit → canary gate →
+  Production hot-swap with zero request errors) and its
+  no-false-positive proof (iid control stream → zero refits) must not
+  vanish or flip — a loop that stops promoting, stops warm-starting,
+  or starts refitting on iid traffic is a regression even when every
+  wall clock holds;
 - **drift proofs**: the sidecar `drift` block's detection proof
   (injected shift FLAGGED with the moved features named), its
   no-false-positive proof (iid holdout CLEAN), and the baseline
@@ -110,6 +117,7 @@ def normalize(doc: dict) -> dict:
             "scale": doc.get("scale"),
             "drift": doc.get("drift"),
             "lint": doc.get("lint"),
+            "ct": doc.get("ct"),
             "shape": "sidecar",
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
@@ -138,6 +146,7 @@ def normalize(doc: dict) -> dict:
         "scale": doc.get("scale"),
         "drift": doc.get("drift"),
         "lint": doc.get("lint"),
+        "ct": doc.get("ct"),
         "shape": "record",
     }
 
@@ -468,6 +477,63 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                     0.0, 0.0, "regression",
                     "baseline save/load round trip no longer "
                     "bit-compatible (reload self-distance != 0)"))
+
+    # ---- continuous-training block (closed-loop promotion proofs)
+    bct, cct = base.get("ct"), cand.get("ct")
+    if bct and not cct and cand.get("shape") != "record":
+        # coverage rule, like the kernel/scale/drift blocks: a sidecar
+        # candidate missing the block lost the closed-loop gate
+        # (bench.py carries it across plain suite runs); driver records
+        # can never carry it
+        reg.append(_finding(
+            "missing-ct-block", "ct", 1.0, 0.0, 0.0, "regression",
+            "continuous-training block present in base, absent in "
+            "candidate"))
+    if bct and cct:
+        bd, cd = bct.get("drift") or {}, cct.get("drift") or {}
+        bi, ci = bct.get("iid") or {}, cct.get("iid") or {}
+        if bd.get("promoted"):
+            checked += 1
+            if not cd.get("promoted"):
+                reg.append(_finding(
+                    "ct-promotion", "drift.promoted", 1.0, 0.0, 0.0,
+                    "regression",
+                    "drift-triggered refit no longer promotes through "
+                    "the canary gate — the loop lost its proof"))
+            elif bd.get("hot_swap") and not cd.get("hot_swap"):
+                reg.append(_finding(
+                    "ct-promotion", "drift.hot_swap", 1.0, 0.0, 0.0,
+                    "regression",
+                    "promotion no longer hot-swaps the live endpoint"))
+            elif int(bd.get("warm_refits", 0)) >= 1 \
+                    and int(cd.get("warm_refits", 0)) < 1:
+                reg.append(_finding(
+                    "ct-promotion", "drift.warm_refits",
+                    float(bd.get("warm_refits", 0)),
+                    float(cd.get("warm_refits", 0)), 0.0, "regression",
+                    "refits no longer warm-start (round-append lost — "
+                    "every trigger refits from scratch)"))
+        if int(bd.get("request_errors", -1)) == 0:
+            checked += 1
+            if int(cd.get("request_errors", -1)) != 0:
+                reg.append(_finding(
+                    "ct-promotion", "drift.request_errors", 0.0,
+                    float(cd.get("request_errors", -1)), 0.0,
+                    "regression",
+                    "promotion window no longer error-free on the "
+                    "serving path"))
+        if bi and int(bi.get("refits", 1)) == 0:
+            checked += 1
+            if not ci or int(ci.get("refits", 0)) != 0:
+                # the no-false-positive proof flipped (the iid control
+                # now refits) or vanished — the drift trigger stopped
+                # discriminating
+                reg.append(_finding(
+                    "ct-false-positive", "iid.refits", 0.0,
+                    float((ci or {}).get("refits", -1)), 0.0,
+                    "regression",
+                    "iid control stream now triggers refits — the "
+                    "drift trigger false-positives"))
 
     # ---- lint block (static-analysis gate receipts)
     bln, cln = base.get("lint"), cand.get("lint")

@@ -103,6 +103,17 @@ COUNTERS = {
     # observation callbacks that raised (observation must never fail a
     # flush, but a dead observer must be visible)
     "drift.*",
+    # continuous training (sml_tpu/ct): ct.cycles / ct.refit_warm /
+    # ct.refit_full / ct.promotions / ct.rollbacks (gate outcomes
+    # applied to the registry) / ct.gate_pass / ct.gate_fail (verdicts)
+    # / ct.checkpoints / ct.resumes (round-level boost restartability)
+    # / ct.cycle_error (background-loop cycles that raised — the loop
+    # survives, the failure is visible)
+    "ct.*",
+    # registry stage-transition listeners that RAISED (the commit
+    # landed; later listeners still fired): a dead subscriber must be
+    # visible in the counters, like serve.canary_error
+    "tracking.listener_error",
     # graftlint gate receipts (bench.py --lint): lint.runs /
     # lint.violations (unsuppressed — 0 on any recorded run, the gate
     # refuses otherwise) / lint.suppressed_pragma /
@@ -163,6 +174,11 @@ EVENTS = {
     # verdict receipts with the flagged-feature list) and drift.chunk
     # (one ingest chunk's sketch judged against the baseline)
     "drift.*",
+    # continuous training (sml_tpu/ct): ct.cycle (one trainer cycle's
+    # action receipt), ct.refit (a scheduled warm/full refit),
+    # ct.promote (canary gate passed — Production moved), ct.rollback
+    # (gate failed — candidate archived, blackbox bundle path in args)
+    "ct.*",
 }
 
 # streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency

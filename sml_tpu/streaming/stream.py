@@ -47,11 +47,22 @@ class StreamManager:
                 return q
         return None
 
-    def awaitAnyTermination(self, timeout: Optional[float] = None) -> None:
+    def awaitAnyTermination(self, timeout: Optional[float] = None) -> bool:
+        """Block until ANY query has terminated (Spark semantics: one
+        termination ends the wait — the pre-fix loop waited for the
+        whole active set to drain, so a supervisor over N long-running
+        queries hung until every stream died). Judged over the queries
+        started so far: one already terminated — including before this
+        call — returns True immediately; `timeout=None` blocks until a
+        termination happens. Returns False only on timeout."""
+        with _lock:
+            started = list(_active_queries)
         t0 = wallclock()
-        while self.active:
+        while True:
+            if not started or any(not q.isActive for q in started):
+                return True
             if timeout is not None and wallclock() - t0 > timeout:
-                return
+                return False
             time.sleep(0.05)
 
 
@@ -234,6 +245,7 @@ class StreamingQuery:
         self._mem_parts: List[pd.DataFrame] = []
         self._ckpt = options.get("checkpointLocation")
         self._processed = self._load_checkpoint()
+        self._ckpt_dirty = False
         self._exception: Optional[BaseException] = None
 
     # -- checkpoint (recovery contract of MLE 00:75-85) --
@@ -267,7 +279,22 @@ class StreamingQuery:
         except BaseException as e:  # surfaced via .exception()
             self._exception = e
         finally:
-            self._stop.set()
+            # a trigger stopped/killed between its sink write landing
+            # and its checkpoint save must still flush EXACTLY ONCE: the
+            # dirty flag is raised right after the write and lowered by
+            # the save, so resume on this checkpointLocation never
+            # reprocesses a committed micro-batch (duplicate rows in an
+            # append sink) and a clean trigger never double-saves
+            try:
+                if self._ckpt_dirty:
+                    self._save_checkpoint()
+                    self._ckpt_dirty = False
+            except Exception:  # noqa: BLE001 — checkpoint dir gone /
+                pass  # serialization failure: resume will reprocess
+            finally:
+                # unconditional: a flush failure must never leave the
+                # query "active" forever (awaitTermination liveness)
+                self._stop.set()
 
     def _process_one_trigger(self) -> bool:
         files = [f for f in self._sdf._list_files() if f not in self._processed]
@@ -279,8 +306,13 @@ class StreamingQuery:
         for op in self._sdf._ops:
             df = op(df)
         self._write_batch(df)
+        # the sink write LANDED: from here the checkpoint must record
+        # this batch even if a stop or exception interrupts before the
+        # save (the _run finally covers the gap via the dirty flag)
         self._processed.update(batch_files)
+        self._ckpt_dirty = True
         self._save_checkpoint()
+        self._ckpt_dirty = False
         self.recentProgress.append({
             "id": self.id, "name": self.name, "numInputRows": df.count(),
             "files": batch_files, "timestamp": wallclock(),

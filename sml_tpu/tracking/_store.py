@@ -23,7 +23,7 @@ import shutil
 import threading
 import uuid
 from typing import Any, Dict, List, Optional
-from ..utils.profiler import wallclock
+from ..utils.profiler import PROFILER, wallclock
 
 _lock = threading.RLock()
 _tracking_root: Optional[str] = None
@@ -321,7 +321,16 @@ def set_version_stage(name: str, version, stage: str,
         _write_json(os.path.join(vd, "meta.json"), meta)
         listeners = list(_stage_listeners)
     for fn in listeners:  # outside the lock: listeners re-read the store
-        fn(name, meta["version"], stage, list(archived))
+        try:
+            fn(name, meta["version"], stage, list(archived))
+        except Exception:  # noqa: BLE001 — listener hygiene: the commit
+            # already landed; one raising listener (a half-closed
+            # endpoint, a torn subscriber) must neither prevent LATER
+            # listeners from observing the transition nor bubble into
+            # the promoter, leaving the stage move half-observed.
+            # Counted (like serve.canary_error) so a dead subscriber is
+            # visible in the engine counters instead of silent
+            PROFILER.count("tracking.listener_error")
     return meta
 
 

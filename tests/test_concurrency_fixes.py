@@ -251,3 +251,123 @@ def test_build_factorized_snapshot_race():
     finally:
         stop.set()
         t.join()
+
+
+# ------------------------------- StreamingQuery shutdown semantics (PR 14)
+def _write_parquet(path, values):
+    import pandas as pd
+    pd.DataFrame({"a": values}).to_parquet(path)
+
+
+def test_forced_stop_mid_trigger_flushes_checkpoint_exactly_once(
+        spark, tmp_path, monkeypatch):
+    """A query killed BETWEEN its sink write landing and its checkpoint
+    save must still flush the checkpoint exactly once (the `_run`
+    finally covers the gap via the dirty flag), so a resumed query on
+    the same checkpointLocation never reprocesses the committed
+    micro-batch — the duplicate-on-resume bug the continuous trainer's
+    supervisor would otherwise inherit."""
+    from sml_tpu.streaming.stream import StreamingQuery
+
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    _write_parquet(src_dir / "p0.parquet", [1.0, 2.0, 3.0])
+    ckpt = str(tmp_path / "ckpt")
+
+    class Forced(RuntimeError):
+        pass
+
+    orig_save = StreamingQuery._save_checkpoint
+    calls = []
+    effective = []
+
+    def flaky_save(self):
+        calls.append(1)
+        if len(calls) == 1:
+            # the forced stop: the write landed, the save did not
+            raise Forced("killed between sink write and checkpoint save")
+        orig_save(self)
+        effective.append(1)
+
+    monkeypatch.setattr(StreamingQuery, "_save_checkpoint", flaky_save)
+    sdf = spark.readStream.schema("a double").parquet(str(src_dir))
+    q = sdf.writeStream.format("memory").queryName("forced_stop_q") \
+        .option("checkpointLocation", ckpt).start()
+    assert q.awaitTermination(10)
+    assert isinstance(q.exception(), Forced)
+    assert effective == [1], "finally must flush the dirty checkpoint ONCE"
+    monkeypatch.setattr(StreamingQuery, "_save_checkpoint", orig_save)
+
+    # resume on the same checkpoint: the committed batch must NOT
+    # reprocess (its file is recorded; nothing new to trigger on)
+    q2 = sdf.writeStream.format("memory").queryName("forced_stop_q2") \
+        .option("checkpointLocation", ckpt) \
+        .trigger(availableNow=True).start()
+    q2.awaitTermination(10)
+    assert q2.exception() is None
+    assert q2.recentProgress == []
+
+
+def test_clean_trigger_saves_checkpoint_exactly_once(spark, tmp_path,
+                                                     monkeypatch):
+    """The exactly-once contract's other half: an UNinterrupted trigger
+    must not double-save through the finally flush."""
+    from sml_tpu.streaming.stream import StreamingQuery
+
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    _write_parquet(src_dir / "p0.parquet", [1.0, 2.0])
+    orig_save = StreamingQuery._save_checkpoint
+    saves = []
+
+    def counting_save(self):
+        saves.append(1)
+        orig_save(self)
+
+    monkeypatch.setattr(StreamingQuery, "_save_checkpoint", counting_save)
+    sdf = spark.readStream.schema("a double").parquet(str(src_dir))
+    q = sdf.writeStream.format("memory").queryName("clean_stop_q") \
+        .option("checkpointLocation", str(tmp_path / "ckpt")) \
+        .trigger(availableNow=True).start()
+    q.awaitTermination(10)
+    assert q.exception() is None
+    assert saves == [1]
+
+
+def test_await_any_termination_releases_on_one_termination(spark,
+                                                           tmp_path,
+                                                           monkeypatch):
+    """`StreamManager.awaitAnyTermination` must return when ANY query
+    terminates (the pre-fix loop waited for ALL active queries to
+    drain) and honor its timeout with a bool result."""
+    from sml_tpu.streaming import stream as stream_mod
+
+    # isolate from queries other tests left in the module registry
+    monkeypatch.setattr(stream_mod, "_active_queries", [])
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    _write_parquet(src_dir / "p0.parquet", [1.0])
+    sdf = spark.readStream.schema("a double").parquet(str(src_dir))
+
+    def start(name):
+        return sdf.writeStream.format("memory").queryName(name).start()
+
+    q1, q2 = start("await_q1"), start("await_q2")
+    try:
+        # both alive: a short timeout must come back False, not hang
+        assert spark.streams.awaitAnyTermination(timeout=0.3) is False
+
+        done = []
+        waiter = threading.Thread(
+            target=lambda: done.append(
+                spark.streams.awaitAnyTermination(timeout=10)),
+            daemon=True)
+        waiter.start()
+        time.sleep(0.2)
+        q1.stop()          # ONE termination must release the wait
+        waiter.join(timeout=10)
+        assert done == [True]
+        assert q2.isActive  # the other query was never awaited on
+    finally:
+        q1.stop()
+        q2.stop()

@@ -1,0 +1,465 @@
+"""Continuous-training pipeline (sml_tpu/ct — ISSUE 14).
+
+Acceptance pins:
+- warm-start round-append parity: N rounds monolithic == k rounds +
+  warm-start (N-k) rounds BIT-IDENTICALLY on the same data/seed, across
+  the monolithic and chunked paths (and across each other);
+- checkpoint-resume-mid-boost equivalence: an interrupted checkpointed
+  fit, resumed, equals the uninterrupted fit bit-identically;
+- live sources: StreamChunkSource / DeltaChunkSource freeze a
+  snapshot() window (re-iterable — the two-pass ingest contract) and
+  advance() consumes it;
+- the closed loop: a drifted window triggers a warm refit that walks
+  the registry → Staging canary → gate → Production hot-swap ladder,
+  an iid window stays clean, and a failed gate rolls back + blackboxes.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import sml_tpu.tracking as mlflow
+from sml_tpu.conf import GLOBAL_CONF
+from sml_tpu.ct import (BoostCheckpoint, CanaryGate, ContinuousTrainer,
+                        DeltaChunkSource, StreamChunkSource,
+                        checkpointed_fit)
+from sml_tpu.frame._chunks import ArrayChunkSource
+from sml_tpu.ml._chunked import (fit_ensemble_chunked,
+                                 warm_start_ensemble_chunked)
+from sml_tpu.ml._tree_models import _fit_ensemble, warm_start_ensemble
+from sml_tpu.ml.regression import GBTRegressionModel
+from sml_tpu.tracking import _store
+
+N, F = 1200, 6
+FIT = dict(categorical={}, max_depth=3, max_bins=16, min_instances=1,
+           min_info_gain=0.0, feature_k=None, bootstrap=False,
+           subsample=1.0, seed=5, loss="squared", step_size=0.3,
+           boosting=True)
+
+
+def _data(n=N, seed=3, shift=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F))
+    if shift:
+        X[:, 0] += 1.5
+        X[:, 2] *= 2.0
+    y = (2.0 * X[:, 0] + 0.5 * X[:, 2] - X[:, 1] ** 2
+         + rng.normal(0, 0.2, n)).astype(np.float32)
+    return X, y
+
+
+def _stacked(spec):
+    return (np.stack([t.split_feature for t in spec.trees]),
+            np.stack([t.split_bin for t in spec.trees]),
+            np.stack([t.leaf_value for t in spec.trees]))
+
+
+def _assert_bit_identical(a, b):
+    sa, sb = _stacked(a), _stacked(b)
+    assert len(a.trees) == len(b.trees)
+    for xa, xb in zip(sa, sb):
+        np.testing.assert_array_equal(xa, xb)
+    assert a.base == b.base
+    np.testing.assert_array_equal(a.tree_weights, b.tree_weights)
+
+
+@pytest.fixture(autouse=True)
+def tracking_dir(tmp_path):
+    mlflow.set_tracking_uri(str(tmp_path / "runs"))
+    # re-anchor the current experiment in THIS root (an earlier test's
+    # set_experiment may have pinned an id from a previous root)
+    mlflow.set_experiment("Default")
+    yield
+    while mlflow.active_run():
+        mlflow.end_run()
+
+
+@pytest.fixture()
+def obs_on(tmp_path):
+    import sml_tpu.obs as obs
+    old = GLOBAL_CONF.get("sml.obs.enabled")
+    old_bb = GLOBAL_CONF.get("sml.obs.blackboxDir")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    # gate-failure rollbacks dump forensics bundles: keep them in tmp
+    GLOBAL_CONF.set("sml.obs.blackboxDir", str(tmp_path / "blackbox"))
+    obs.reset()
+    yield
+    GLOBAL_CONF.set("sml.obs.enabled", old)
+    GLOBAL_CONF.set("sml.obs.blackboxDir", old_bb)
+
+
+# --------------------------------------------------- warm-start parity
+def test_warm_start_parity_monolithic():
+    """N rounds == k rounds + warm-start (N-k) rounds, bit-identical."""
+    X, y = _data()
+    full = _fit_ensemble(X, y, n_trees=8, **FIT)
+    part = _fit_ensemble(X, y, n_trees=3, **FIT)
+    warm = warm_start_ensemble(part, X, y, n_new_trees=5, seed=5,
+                               step_size=0.3)
+    _assert_bit_identical(full, warm)
+
+
+def test_warm_start_parity_chunked_and_cross_path():
+    """The chunked warm start equals BOTH the chunked N-round fit and
+    the monolithic one (exact-mode sketch ⇒ identical edges), including
+    under a staged rounds_per_dispatch."""
+    X, y = _data()
+    mono_full = _fit_ensemble(X, y, n_trees=8, **FIT)
+    ck = dict(categorical={}, max_depth=3, max_bins=16, seed=5,
+              loss="squared", step_size=0.3, boosting=True)
+    chunked_full = fit_ensemble_chunked(
+        ArrayChunkSource(X, y, chunk_rows=257), n_trees=8, **ck)
+    part = fit_ensemble_chunked(
+        ArrayChunkSource(X, y, chunk_rows=257), n_trees=3, **ck)
+    warm = warm_start_ensemble_chunked(
+        part, ArrayChunkSource(X, y, chunk_rows=257), n_new_trees=5,
+        seed=5, step_size=0.3, rounds_per_dispatch=2)
+    _assert_bit_identical(chunked_full, warm)
+    _assert_bit_identical(mono_full, warm)
+
+
+def test_warm_start_rejects_step_size_change():
+    """A different step_size would rescale the SAVED rounds' margin
+    replay and weights — silently changing the incumbent's predictions
+    retroactively. Refuse, don't reweight."""
+    X, y = _data(600)
+    part = _fit_ensemble(X, y, n_trees=3, **FIT)   # step 0.3
+    with pytest.raises(ValueError, match="step_size"):
+        warm_start_ensemble(part, X, y, n_new_trees=2, seed=5,
+                            step_size=0.1)
+    # the saved step (f32-rounded or not) passes the guard
+    warm_start_ensemble(part, X, y, n_new_trees=1, seed=5,
+                        step_size=float(np.float32(0.3)))
+
+
+def test_warm_start_rejects_non_boosted_spec():
+    X, y = _data(600)
+    forest = _fit_ensemble(X, y, n_trees=3,
+                           **{**FIT, "boosting": False,
+                              "bootstrap": True})
+    with pytest.raises(ValueError, match="boosted"):
+        warm_start_ensemble(forest, X, y, n_new_trees=2, seed=5)
+
+
+# --------------------------------------------- checkpoint-resume parity
+def test_checkpoint_resume_mid_boost_equivalence(tmp_path):
+    """An interrupted checkpointed fit, re-run with the same target,
+    resumes from the last dispatch boundary and finishes bit-identical
+    to the uninterrupted fit (ct.resumes counts the resume)."""
+    from sml_tpu.utils.profiler import PROFILER
+    X, y = _data()
+    src = lambda: ArrayChunkSource(X, y, chunk_rows=400)  # noqa: E731
+    params = dict(n_trees=6, max_depth=3, max_bins=16, seed=5,
+                  step_size=0.3, rounds_per_dispatch=2)
+    ckdir = str(tmp_path / "ck")
+    full = checkpointed_fit(src(), ckdir, **params)
+    assert not os.path.exists(ckdir)  # cleared on success
+
+    class Interrupt(RuntimeError):
+        pass
+
+    orig_save = BoostCheckpoint.save
+    calls = [0]
+
+    def dying_save(self, spec, t, meta):
+        orig_save(self, spec, t, meta)
+        calls[0] += 1
+        if calls[0] == 2:  # die right after the round-4 checkpoint
+            raise Interrupt()
+
+    BoostCheckpoint.save = dying_save
+    try:
+        with pytest.raises(Interrupt):
+            checkpointed_fit(src(), ckdir, **params)
+    finally:
+        BoostCheckpoint.save = orig_save
+    ck = BoostCheckpoint(ckdir)
+    partial, meta = ck.load()
+    assert len(partial.trees) == 4 and meta["t"] == 4
+    prev_prof = GLOBAL_CONF.get("sml.profiler.enabled")
+    GLOBAL_CONF.set("sml.profiler.enabled", True)
+    try:
+        before = PROFILER.counters().get("ct.resumes", 0.0)
+        resumed = checkpointed_fit(src(), ckdir, **params)
+        assert PROFILER.counters().get("ct.resumes", 0.0) == before + 1
+    finally:
+        GLOBAL_CONF.set("sml.profiler.enabled", prev_prof)
+    _assert_bit_identical(full, resumed)
+    assert not os.path.exists(ckdir)
+
+
+def test_checkpointed_warm_start_resume_and_foreign_guard(tmp_path):
+    """A preempted checkpointed WARM refit resumes bit-identically; a
+    checkpoint left by one fit shape never poisons another (mode/param
+    mismatch clears it and the fit starts clean)."""
+    from sml_tpu.ct import checkpointed_warm_start
+    X, y = _data()
+    src = lambda: ArrayChunkSource(X, y, chunk_rows=400)  # noqa: E731
+    base_spec = _fit_ensemble(X, y, n_trees=2, **FIT)
+    ckdir = str(tmp_path / "ck")
+    wargs = dict(n_new_trees=4, seed=5, step_size=0.3,
+                 rounds_per_dispatch=2)
+    uninterrupted = checkpointed_warm_start(base_spec, src(), ckdir,
+                                            **wargs)
+    assert not os.path.exists(ckdir)
+
+    class Interrupt(RuntimeError):
+        pass
+
+    orig_save = BoostCheckpoint.save
+
+    def dying_save(self, spec, t, meta):
+        orig_save(self, spec, t, meta)
+        raise Interrupt()  # die after the first (round-4) checkpoint
+
+    BoostCheckpoint.save = dying_save
+    try:
+        with pytest.raises(Interrupt):
+            checkpointed_warm_start(base_spec, src(), ckdir, **wargs)
+    finally:
+        BoostCheckpoint.save = orig_save
+    partial, meta = BoostCheckpoint(ckdir).load()
+    assert meta["mode"] == "warm" and len(partial.trees) == 4
+
+    # a FULL checkpointed fit must not resume the warm checkpoint: the
+    # guard clears it and the fresh fit equals a clean-directory fit
+    clean = checkpointed_fit(src(), str(tmp_path / "other"), n_trees=6,
+                             max_depth=3, max_bins=16, seed=5,
+                             step_size=0.3, rounds_per_dispatch=2)
+    guarded = checkpointed_fit(src(), ckdir, n_trees=6, max_depth=3,
+                               max_bins=16, seed=5, step_size=0.3,
+                               rounds_per_dispatch=2)
+    _assert_bit_identical(clean, guarded)
+
+    # ...and a matching warm re-run DOES resume (bit-identical)
+    BoostCheckpoint.save = dying_save
+    try:
+        with pytest.raises(Interrupt):
+            checkpointed_warm_start(base_spec, src(), ckdir, **wargs)
+    finally:
+        BoostCheckpoint.save = orig_save
+    resumed = checkpointed_warm_start(base_spec, src(), ckdir, **wargs)
+    _assert_bit_identical(uninterrupted, resumed)
+
+
+# ------------------------------------------------------------- sources
+def test_stream_chunk_source_snapshot_advance(spark, tmp_path):
+    src_dir = tmp_path / "stream-src"
+    src_dir.mkdir()
+    X, y = _data(300, seed=9)
+    cols = [f"f{i}" for i in range(F)]
+
+    def part(path, lo, hi):
+        pdf = pd.DataFrame({c: X[lo:hi, i] for i, c in enumerate(cols)})
+        pdf["y"] = y[lo:hi].astype(float)
+        pdf.to_parquet(path)
+
+    part(src_dir / "p0.parquet", 0, 100)
+    part(src_dir / "p1.parquet", 100, 200)
+    schema = ", ".join(f"{c} double" for c in cols) + ", y double"
+    sdf = spark.readStream.schema(schema) \
+        .option("maxFilesPerTrigger", 1).parquet(str(src_dir))
+    q = sdf.writeStream.format("memory").queryName("ct_src_q").start()
+    try:
+        q.processAllAvailable()
+        src = StreamChunkSource(q, cols, "y", chunk_rows=64)
+        assert src.snapshot() == 200
+        got = np.concatenate([c for c, _ in src.chunks()])
+        np.testing.assert_array_equal(got, X[:200])
+        # re-iterable (the two-pass ingest contract)
+        got2 = np.concatenate([c for c, _ in src.chunks()])
+        np.testing.assert_array_equal(got, got2)
+        src.advance()
+        part(src_dir / "p2.parquet", 200, 300)
+        q.processAllAvailable()
+        assert src.snapshot() == 100
+        got3 = np.concatenate([c for c, _ in src.chunks()])
+        np.testing.assert_array_equal(got3, X[200:])
+    finally:
+        q.stop()
+    with pytest.raises(ValueError, match="memory-sink"):
+        StreamChunkSource(object(), cols, "y")
+
+
+def test_delta_chunk_source_watermark(spark, tmp_path):
+    dpath = str(tmp_path / "delta-src")
+    X, y = _data(500, seed=13)
+    cols = [f"f{i}" for i in range(F)]
+
+    def write(lo, hi, mode):
+        pdf = pd.DataFrame({c: X[lo:hi, i] for i, c in enumerate(cols)})
+        pdf["y"] = y[lo:hi].astype(float)
+        spark.createDataFrame(pdf).write.format("delta") \
+            .mode(mode).save(dpath)
+
+    write(0, 300, "errorifexists")
+    src = DeltaChunkSource(dpath, cols, "y", chunk_rows=128)
+    assert src.snapshot() == 300
+    a = np.concatenate([c for c, _ in src.chunks()])
+    b = np.concatenate([c for c, _ in src.chunks()])
+    np.testing.assert_array_equal(a, b)   # re-iterable
+    assert a.shape == (300, F)
+    src.advance()
+    assert src.snapshot() == 0            # nothing new yet
+    write(300, 500, "append")
+    assert src.snapshot() == 200          # only the new version's rows
+    got = np.concatenate([c for c, _ in src.chunks()])
+    assert got.shape == (200, F)
+    ys = np.concatenate([yy for _, yy in src.chunks()])
+    np.testing.assert_allclose(ys, y[300:].astype(np.float64))
+
+
+# --------------------------------------------------------- closed loop
+def _seed_registry(name, X, y):
+    spec = fit_ensemble_chunked(
+        ArrayChunkSource(X, y, chunk_rows=700), categorical={},
+        max_depth=3, max_bins=16, n_trees=6, seed=7, loss="squared",
+        step_size=0.3, boosting=True)
+    assert spec.baseline is not None
+    with mlflow.start_run():
+        mlflow.spark.log_model(GBTRegressionModel(spec), "model",
+                               registered_model_name=name)
+    _store.set_version_stage(name, 1, "Production")
+    return spec
+
+
+def _delta_append(spark, path, X, y, cols):
+    pdf = pd.DataFrame({c: X[:, i] for i, c in enumerate(cols)})
+    pdf["y"] = y.astype(float)
+    mode = "append" if os.path.exists(path) else "errorifexists"
+    spark.createDataFrame(pdf).write.format("delta").mode(mode).save(path)
+
+
+def test_trainer_closed_loop_promotes_on_drift(spark, tmp_path, obs_on):
+    """Drifted window → warm refit → Staging canary → gate pass →
+    Production hot-swap on the live endpoint; iid window stays clean."""
+    from sml_tpu.serving import ServingEndpoint
+    cols = [f"f{i}" for i in range(F)]
+    Xt, yt = _data(2800, seed=11)
+    _seed_registry("ct-loop", Xt, yt)
+    dpath = str(tmp_path / "stream")
+    with ServingEndpoint("ct-loop", "Production", canary_fraction=1.0,
+                         flush_micros=500) as ep:
+        trainer = ContinuousTrainer(
+            "ct-loop", DeltaChunkSource(dpath, cols, "y"),
+            endpoint=ep,
+            gate=CanaryGate(min_mirrored=3, timeout_s=20.0,
+                            quality_tol=1.2, batch_rows=64),
+            fit_params={"seed": 7, "rounds_per_dispatch": 2},
+            warm_rounds=3, min_rows=512, full_severity=1e9)
+        # under min_rows: accumulate, watermark holds
+        Xs, ys = _data(200, seed=20)
+        _delta_append(spark, dpath, Xs, ys, cols)
+        assert trainer.step()["action"] == "accumulate"
+        # iid top-up past min_rows: clean cycle, no refit
+        Xs, ys = _data(600, seed=21)
+        _delta_append(spark, dpath, Xs, ys, cols)
+        rep = trainer.step()
+        assert rep["action"] == "clean" and rep["severity"] < 1.0
+        assert ep.current_version() == 1
+        # drifted window: warm refit → gate → promote → hot-swap
+        Xs, ys = _data(900, seed=22, shift=True)
+        _delta_append(spark, dpath, Xs, ys, cols)
+        rep = trainer.step()
+        assert rep["action"] == "promoted", rep
+        assert rep["refit"] == "warm"
+        assert rep["severity"] >= 1.0
+        gate = rep["gate"]
+        assert gate["passed"] and gate["request_errors"] == 0
+        assert gate["rmse_candidate"] <= gate["rmse_incumbent"] * 1.2
+        assert ep.current_version() == 2    # hot-swapped in-process
+    v1 = _store.get_model_version("ct-loop", 1)
+    v2 = _store.get_model_version("ct-loop", 2)
+    assert v1["current_stage"] == "Archived"
+    assert v2["current_stage"] == "Production"
+    # the warm refit appended rounds instead of refitting from scratch
+    stats = trainer.stats()
+    assert stats["warm_refits"] == 1 and stats["full_refits"] == 0
+    assert stats["promotions"] == 1 and stats["rollbacks"] == 0
+    # the refit landed as a tracked run under the registered lineage
+    runs = [r for e in _store.list_experiments()
+            for r in _store.list_runs(e["experiment_id"])
+            if r["tags"].get("ct.trainer") == "ct-loop"]
+    assert len(runs) == 1
+    assert runs[0]["params"]["ct.mode"] == "warm"
+    assert runs[0]["metrics"]["ct.gate_passed"] == 1.0
+
+
+def test_trainer_gate_failure_rolls_back(spark, tmp_path, obs_on):
+    """An unobservable canary (mirror quorum unmet) fails the gate:
+    the candidate archives, Production stays on the incumbent, and the
+    rollback is counted."""
+    from sml_tpu.serving import ServingEndpoint
+    cols = [f"f{i}" for i in range(F)]
+    Xt, yt = _data(2400, seed=11)
+    _seed_registry("ct-rollback", Xt, yt)
+    dpath = str(tmp_path / "stream")
+    with ServingEndpoint("ct-rollback", "Production",
+                         canary_fraction=1.0, flush_micros=500) as ep:
+        trainer = ContinuousTrainer(
+            "ct-rollback", DeltaChunkSource(dpath, cols, "y"),
+            endpoint=ep,
+            gate=CanaryGate(min_mirrored=10 ** 6, timeout_s=0.2,
+                            quality_tol=1.2, batch_rows=64),
+            fit_params={"seed": 7}, warm_rounds=3, min_rows=512,
+            full_severity=1e9)
+        Xs, ys = _data(900, seed=22, shift=True)
+        _delta_append(spark, dpath, Xs, ys, cols)
+        rep = trainer.step()
+        assert rep["action"] == "rolled_back", rep
+        assert rep["gate"]["passed"] is False
+        assert rep["gate"]["checks"]["mirrored"] is False
+        assert ep.current_version() == 1    # incumbent keeps serving
+    assert _store.get_model_version("ct-rollback", 2)["current_stage"] \
+        == "Archived"
+    assert _store.resolve_stage("ct-rollback", "Production")["version"] == 1
+    assert trainer.stats()["rollbacks"] == 1
+    # the refusal left a forensics bundle behind
+    bb = tmp_path / "blackbox"
+    assert bb.exists() and any(bb.iterdir())
+
+
+def test_trainer_background_loop_accumulates_and_stops(spark, tmp_path,
+                                                       obs_on):
+    """The start()/stop() loop runs cycles on its thread and shuts
+    down cleanly; an under-min_rows source just accumulates."""
+    import time
+    cols = [f"f{i}" for i in range(F)]
+    Xt, yt = _data(2400, seed=11)
+    _seed_registry("ct-bg", Xt, yt)
+    dpath = str(tmp_path / "stream")
+    Xs, ys = _data(100, seed=20)
+    _delta_append(spark, dpath, Xs, ys, cols)
+    trainer = ContinuousTrainer(
+        "ct-bg", DeltaChunkSource(dpath, cols, "y"),
+        fit_params={"seed": 7}, min_rows=512)
+    trainer.start(poll_s=0.05)
+    deadline = time.monotonic() + 10.0
+    while trainer.stats()["cycles"] < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    trainer.stop()
+    stats = trainer.stats()
+    assert stats["cycles"] >= 2
+    assert stats["accumulating"] == stats["cycles"]
+    assert stats["refits"] == 0 and stats["errors"] == 0
+    assert not trainer._thread.is_alive()
+
+
+def test_gate_without_endpoint_judges_quality_only():
+    """No live endpoint yet: the gate rests on the quality bar (the
+    candidate must not be worse than the incumbent on the window)."""
+    X, y = _data(900, seed=23, shift=True)
+    inc = _fit_ensemble(*_data(1200, seed=3), n_trees=6, **FIT)
+    cand = warm_start_ensemble(inc, X, y, n_new_trees=3, seed=5,
+                               step_size=0.3)
+    gate = CanaryGate(quality_tol=1.2)
+    verdict = gate.run(None, X, y, cand, inc)
+    assert verdict["passed"] is True
+    assert "mirrored" not in verdict
+    assert verdict["rmse_candidate"] <= verdict["rmse_incumbent"] * 1.2
+    # a candidate that is much worse than the incumbent must fail
+    bad = gate.run(None, X, y, inc, cand)
+    assert (bad["passed"] is False) == (
+        bad["rmse_candidate"] > bad["rmse_incumbent"] * 1.2)

@@ -95,6 +95,39 @@ def test_resolve_stage_and_transition_listener(registered_pair):
         == "Archived"
 
 
+def test_raising_listener_does_not_block_later_listeners(registered_pair,
+                                                         profiler_on):
+    """Listener hygiene (PR 14): a raising on_stage_transition listener
+    must not prevent later listeners from observing the commit, must
+    not bubble into the promoter, and must be COUNTED
+    (tracking.listener_error) instead of silent."""
+    calls = []
+
+    def bad(name, v, stage, archived):
+        calls.append("bad")
+        raise RuntimeError("torn subscriber")
+
+    def good(name, v, stage, archived):
+        calls.append("good")
+
+    _store.on_stage_transition(bad)
+    _store.on_stage_transition(good)
+    try:
+        before = _counter("tracking.listener_error")
+        meta = _store.set_version_stage("serve-model", 2, "Production",
+                                        archive_existing_versions=True)
+    finally:
+        _store.remove_stage_listener(bad)
+        _store.remove_stage_listener(good)
+    assert meta["current_stage"] == "Production"
+    assert calls == ["bad", "good"]  # the later listener still fired
+    assert _counter("tracking.listener_error") == before + 1
+    # the commit is fully observed, not half-applied
+    assert _store.resolve_stage("serve-model", "Production")["version"] == 2
+    assert _store.get_model_version("serve-model", 1)["current_stage"] \
+        == "Archived"
+
+
 def test_bad_promote_does_not_archive_incumbent(registered_pair):
     """Validation-order fix: a transition to a missing version must not
     half-apply (archiving the incumbents, then raising)."""
