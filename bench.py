@@ -1957,6 +1957,320 @@ def ct_main(rows: int) -> None:
         sys.exit(1)
 
 
+FLEET_REQUESTS = 10_000
+
+
+def run_fleet(requests: int = FLEET_REQUESTS) -> dict:
+    """`--fleet`: the multi-replica serving-fleet proof (ISSUE 15) —
+    register a linear model (v1 Production, v2 clean twin, v3 injected
+    divergence), spin a warm 2-replica `fleet.ReplicaPool`, and drive a
+    closed-loop load of `requests` requests through the `Router` across
+    the three priority classes:
+
+    - per-replica queue attribution + per-class p50/p99/shed under the
+      published SLO (`sml.serve.sloMillis`), shedding priority-ordered
+      (low first, high never — it degrades through the host ladder);
+    - at least one occupancy-driven scale-UP during the load and one
+      scale-DOWN after it (autoscaler bands);
+    - a staged rollout of the clean candidate that PROMOTES, then one
+      of the divergent candidate that AUTO-ROLLS-BACK, archives, and
+      evicts the diverging replica with its black-box bundle on disk;
+    - zero hung futures, and per-request trace ids recoverable through
+      the router fan-in (`fleet.route` events × admission spans).
+
+    Results merge into the bench sidecar as the `fleet` block, rendered
+    by scripts/render_perf.py; a vanished block, a lost rollback or
+    scale proof, a hung future, or a shed-rate/p99 regression is
+    flagged by obs/regress.py."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import pandas as pd
+
+    import sml_tpu.tracking as mlflow
+    from sml_tpu import TpuSession, obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ct import CanaryGate
+    from sml_tpu.fleet import Autoscaler, ReplicaPool, Router
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    from sml_tpu.serving import RequestShed
+    from sml_tpu.tracking import _store
+    from sml_tpu.utils.profiler import PROFILER
+
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    prev_prof = GLOBAL_CONF.get("sml.profiler.enabled")
+    prev_ring = GLOBAL_CONF.get("sml.obs.ringEvents")
+    prev_uri = _store.get_tracking_uri()
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    GLOBAL_CONF.set("sml.profiler.enabled", True)
+    # the fan-in proof scans the ring for every routed request's trace:
+    # size it so a 10k-request load cannot evict its own evidence
+    GLOBAL_CONF.set("sml.obs.ringEvents", 1 << 18)
+    tmp = tempfile.mkdtemp(prefix="sml-fleet-bench-")
+    mlflow.set_tracking_uri(os.path.join(tmp, "runs"))
+    spark = TpuSession.builder.appName("fleet-bench").getOrCreate()
+
+    def fit(seed, slope):
+        rng = np.random.default_rng(seed)
+        pdf = pd.DataFrame({"a": rng.normal(size=4000),
+                            "b": rng.normal(size=4000)})
+        pdf["y"] = slope * pdf["a"] - pdf["b"] + 1.0 \
+            + rng.normal(0, 0.1, len(pdf))
+        va = VectorAssembler(inputCols=["a", "b"], outputCol="features")
+        return Pipeline(stages=[va, LinearRegression(labelCol="y")]) \
+            .fit(spark.createDataFrame(pdf))
+
+    pool = None
+    try:
+        obs.reset()
+        for m in (fit(3, 2.0), fit(3, 2.0), fit(9, -4.0)):
+            with mlflow.start_run():
+                mlflow.spark.log_model(
+                    m, "model", registered_model_name="fleet-bench-model")
+        _store.set_version_stage("fleet-bench-model", 1, "Production")
+
+        classes = ["high", "normal", "low"]
+        rows_per_req = 32
+        queue_rows = 128
+        pool = ReplicaPool(
+            "fleet-bench-model", replicas=2, canary_fraction=1.0,
+            flush_micros=8000, queue_rows=queue_rows, timeout_millis=0,
+            host_fallback=True,
+            blackbox_dir=os.path.join(tmp, "blackbox"))
+        router = Router(pool, priorities=classes)
+        asc = Autoscaler(pool, router, min_replicas=2, max_replicas=3,
+                         scale_up_occupancy=0.5, scale_down_occupancy=0.1)
+
+        # ---- closed-loop load: 12 clients over 3 priority classes ----
+        X = np.random.default_rng(5).normal(
+            size=(rows_per_req, 2)).astype(np.float32)
+        clients = {"high": 3, "normal": 4, "low": 5}
+        share = {"high": 0.2, "normal": 0.4, "low": 0.4}
+        lat = {c: [] for c in classes}
+        shed = {c: 0 for c in classes}
+        hung = [0]
+        lat_lock = threading.Lock()
+
+        def client(cls, n):
+            my_lat, my_shed = [], 0
+            for _ in range(n):
+                t0 = time.perf_counter()
+                try:
+                    router.submit(X, cls).result(30.0)
+                    my_lat.append((time.perf_counter() - t0) * 1e3)
+                except RequestShed:
+                    my_shed += 1
+                except TimeoutError:
+                    with lat_lock:
+                        hung[0] += 1
+            with lat_lock:
+                lat[cls].extend(my_lat)
+                shed[cls] += my_shed
+
+        threads = []
+        sent = {c: 0 for c in classes}
+        for cls in classes:
+            per = int(requests * share[cls]) // clients[cls]
+            for _ in range(clients[cls]):
+                sent[cls] += per
+                threads.append(threading.Thread(
+                    target=client, args=(cls, per)))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        actions = []
+        peak = pool.size()
+        while any(t.is_alive() for t in threads):
+            time.sleep(0.25)
+            actions.append(asc.step()["action"])
+            peak = max(peak, pool.size())
+        for t in threads:
+            t.join()
+        load_s = time.perf_counter() - t0
+        # ---- cooldown: the idle fleet retires back to the floor (a
+        # load lull may already have retired it mid-run — band events
+        # count wherever they fired) -----------------------------------
+        for _ in range(3):
+            a = asc.step()["action"]
+            actions.append(a)
+            if a == "down":
+                break
+        up_events = sum(1 for a in actions if a in ("up", "backfill"))
+        down_events = sum(1 for a in actions if a == "down")
+        # the SLO snapshot (and its all-time worst-request exemplar) is
+        # taken HERE, before the rollouts: gate traffic drives the
+        # endpoints directly (no fleet.route event), so a slow gate
+        # request after this point must not become the "worst" the
+        # fan-in proof then fails to find among the router's traces
+        slo = obs.slo_report()
+
+        # ---- staged rollouts: clean promote, then forced rollback ----
+        gate = CanaryGate(min_mirrored=4, timeout_s=30.0,
+                          max_abs_diff=0.05, batch_rows=64)
+        Xg = np.random.default_rng(6).normal(size=(256, 2)) \
+            .astype(np.float32)
+        _store.set_version_stage("fleet-bench-model", 2, "Staging")
+        clean = pool.promote(2, gate=gate, X=Xg)
+        _store.set_version_stage("fleet-bench-model", 3, "Staging")
+        rollback = pool.promote(3, gate=gate, X=Xg)
+        bb = rollback.get("blackbox")
+        bb_ok = bool(bb) and os.path.isfile(
+            os.path.join(bb, "MANIFEST.json"))
+        backfilled = asc.step()["action"]  # refill the evicted slot
+
+        # ---- trace fan-in proof: router decision ↔ admission span ----
+        route_traces, request_traces = set(), set()
+        for ev in obs.RECORDER.events():
+            if ev.name == "fleet.route":
+                tid = (ev.args or {}).get("trace")
+                if tid is not None:
+                    route_traces.add(tid)
+            elif ev.name == "trace.request":
+                request_traces.add((ev.args or {}).get("trace"))
+        fanin = len(route_traces & request_traces)
+        worst_hex = slo.get("worst_trace")
+        worst_in_fanin = (worst_hex is not None
+                          and int(worst_hex, 16) in route_traces)
+        fanin_ok = fanin > 0 and worst_in_fanin
+
+        health = obs.engine_health()
+        counters = PROFILER.counters()
+        per_class = {}
+        rates = {}
+        for cls in classes:
+            ls = sorted(lat[cls])
+            served = len(ls)
+            rate = shed[cls] / max(sent[cls], 1)
+            rates[cls] = rate
+            per_class[cls] = {
+                "requests": sent[cls],
+                "served": served,
+                "shed": shed[cls],
+                "shed_rate": round(rate, 4),
+                "fleet_shed_counter": counters.get(
+                    f"fleet.shed.{cls}", 0.0),
+                "p50_ms": round(ls[len(ls) // 2], 3) if ls else None,
+                "p99_ms": round(ls[min(int(len(ls) * 0.99),
+                                       len(ls) - 1)], 3) if ls else None,
+            }
+        priority_order_ok = (rates["low"] >= rates["normal"]
+                             >= rates["high"] and rates["high"] == 0.0)
+        block = {
+            "requests": sum(sent.values()),
+            "rows_per_request": rows_per_req,
+            "queue_rows": queue_rows,
+            "backend": jax.default_backend(),
+            "load_seconds": round(load_s, 3),
+            "replicas": {"initial": 2, "min": 2, "max": 3, "peak": peak,
+                         "final": pool.size()},
+            "slo": {"target_ms": slo["target_ms"],
+                    "burn_rate": slo["burn_rate"],
+                    "breaches": slo["breaches"]},
+            "priority": per_class,
+            "priority_order_ok": bool(priority_order_ok),
+            "hung_futures": int(hung[0]),
+            "reroutes": counters.get("fleet.reroutes", 0.0),
+            "scale": {"up_events": up_events, "down_events": down_events,
+                      "up_ok": bool(up_events >= 1),
+                      "down_ok": bool(down_events >= 1),
+                      "events": [a for a in actions if a != "hold"],
+                      "post_rollback": backfilled},
+            "rollout": {
+                "clean": {"passed": bool(clean["passed"]),
+                          "stages": len(clean["stages"])},
+                "rollback": {
+                    "rolled_back": bool(not rollback["passed"]
+                                        and rollback["action"]
+                                        == "rolled_back"),
+                    "evicted": rollback.get("evicted"),
+                    "divergence_check": (rollback.get("checks") or {})
+                    .get("divergence"),
+                    "blackbox_on_disk": bool(bb_ok)},
+            },
+            "trace": {"worst_ms": slo["worst_ms"],
+                      "worst_trace": worst_hex,
+                      "route_events": len(route_traces),
+                      "fanin_requests": fanin,
+                      "fanin_ok": bool(fanin_ok)},
+            "shed_by_reason": dict(health["shed"]["by_reason"]),
+            "note": "closed loop: Router priority admission over "
+                    "per-replica QueuePressure -> micro-batched "
+                    "replicas -> occupancy-banded Autoscaler; staged "
+                    "rollout via per-replica CanaryGate pins with "
+                    "auto-rollback + forensic eviction "
+                    "(docs/FLEET.md)",
+        }
+        ok = (hung[0] == 0
+              and block["scale"]["up_ok"] and block["scale"]["down_ok"]
+              and block["rollout"]["clean"]["passed"]
+              and block["rollout"]["rollback"]["rolled_back"]
+              and block["rollout"]["rollback"]["evicted"] is not None
+              and bb_ok
+              and priority_order_ok and shed["low"] > 0
+              and fanin_ok)
+        block["fleet_ok"] = bool(ok)
+        print(f"  fleet: {block['requests']} requests over "
+              f"{len(classes)} classes in {load_s:.1f}s — shed "
+              f"low/normal/high = {shed['low']}/{shed['normal']}/"
+              f"{shed['high']}, scale up×{up_events} down×"
+              f"{down_events} (peak {peak}), clean rollout "
+              f"{'PROMOTED' if clean['passed'] else 'FAILED'}, "
+              f"divergent rollout "
+              f"{'ROLLED BACK' if not rollback['passed'] else 'PASSED?!'}"
+              f" (evicted r{rollback.get('evicted')}, blackbox "
+              f"{'ok' if bb_ok else 'MISSING'}), hung {hung[0]}, "
+              f"fan-in {'ok' if fanin_ok else 'LOST'}", file=sys.stderr)
+        return block
+    finally:
+        # close BEFORE the tmp dir (blackbox/tracking roots) vanishes
+        # under live replicas — a mid-proof exception must not leak
+        # flush threads and a registered pool into the rest of the run
+        if pool is not None:
+            try:
+                pool.close()
+            except Exception:
+                pass
+        GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
+        GLOBAL_CONF.set("sml.profiler.enabled", bool(prev_prof))
+        GLOBAL_CONF.set("sml.obs.ringEvents", int(prev_ring))
+        mlflow.set_tracking_uri(prev_uri)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def fleet_main(requests: int) -> None:
+    """Run the fleet leg standalone, merge the `fleet` block into the
+    bench sidecar, and print the short headline JSON last."""
+    block = run_fleet(requests)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["fleet"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "serving-fleet closed loop (priority shed ladder, "
+                  "autoscale cycle, staged rollout + rollback)",
+        "value": 1.0 if block["fleet_ok"] else 0.0,
+        "unit": "1 = priority-ordered shed + scale up/down + clean "
+                "promote + divergent rollback w/ blackbox + zero hung "
+                "futures + trace fan-in recoverable",
+        "requests": block["requests"],
+        "hung_futures": block["hung_futures"],
+        "scale_up": block["scale"]["up_events"],
+        "scale_down": block["scale"]["down_events"],
+        "rolled_back": block["rollout"]["rollback"]["rolled_back"],
+        "backend": block["backend"],
+        "legs_file": "bench_legs.json",
+    }))
+    if not block["fleet_ok"]:
+        sys.exit(1)
+
+
 # ----------------------------------------------------------------- goldens
 def check_goldens(metrics):
     """Compare this run's metric values against the CPU-mesh 1M-row pins
@@ -2265,7 +2579,7 @@ def main():
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
             for block in ("multichip", "kernel", "kernel_infer", "scale",
-                          "drift", "lint", "ct"):
+                          "drift", "lint", "ct", "fleet"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):
@@ -2415,6 +2729,23 @@ if __name__ == "__main__":
                              "exits 1 when any proof fails")
     parser.add_argument("--ct-rows", type=int, default=CT_ROWS,
                         help="seed-model training rows for the --ct leg")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run ONLY the multi-replica serving-fleet "
+                             "proof (closed-loop priority-classed load "
+                             "through the Router over a warm "
+                             "ReplicaPool: per-class p50/p99/shed under "
+                             "the SLO, one occupancy scale-up + one "
+                             "scale-down, a clean staged rollout that "
+                             "promotes and a divergent one that "
+                             "auto-rolls-back with the evicted "
+                             "replica's blackbox bundle, zero hung "
+                             "futures, trace fan-in) and merge the "
+                             "`fleet` block into the bench sidecar; "
+                             "exits 1 when any proof fails")
+    parser.add_argument("--fleet-requests", type=int,
+                        default=FLEET_REQUESTS,
+                        help="closed-loop request count for the "
+                             "--fleet leg")
     parser.add_argument("--lint", action="store_true",
                         help="gate the run on a clean graftlint pass: a "
                              "bench record from a tree violating engine "
@@ -2446,6 +2777,8 @@ if __name__ == "__main__":
              if args.drift else
              (lambda: ct_main(args.ct_rows))
              if args.ct else
+             (lambda: fleet_main(args.fleet_requests))
+             if args.fleet else
              (lambda: scale_main(args.rows))
              if args.rows else main)
     if args.blackbox_on_fail:

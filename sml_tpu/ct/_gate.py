@@ -47,7 +47,8 @@ class CanaryGate:
     def __init__(self, min_mirrored: Optional[int] = None,
                  timeout_s: Optional[float] = None,
                  quality_tol: Optional[float] = None,
-                 batch_rows: int = 256):
+                 batch_rows: int = 256,
+                 max_abs_diff: Optional[float] = None):
         self._min_mirrored = (
             int(min_mirrored) if min_mirrored is not None
             else GLOBAL_CONF.getInt("sml.ct.canaryMinMirrored"))
@@ -58,6 +59,15 @@ class CanaryGate:
             float(quality_tol) if quality_tol is not None
             else float(GLOBAL_CONF.get("sml.ct.gateQualityTol")))
         self._batch_rows = max(int(batch_rows), 1)
+        # optional HARD divergence bound (the fleet rollout's injected-
+        # divergence tripwire): past it the mirrored WORST-ROW
+        # |candidate - incumbent| (the max_abs_diff stat, matching this
+        # kwarg's name — one catastrophic row must not hide in a benign
+        # mean) fails the divergence check even when finite. None (the
+        # default) keeps the PR-14 finite-only judgment — a
+        # drift-triggered refit is SUPPOSED to diverge on drifted data
+        self._max_abs_diff = (None if max_abs_diff is None
+                              else float(max_abs_diff))
 
     def run(self, endpoint, X: np.ndarray, y: Optional[np.ndarray],
             candidate_spec, incumbent_spec) -> Dict[str, object]:
@@ -98,7 +108,10 @@ class CanaryGate:
             # NaN-scoring candidate cannot hide from
             checks["divergence"] = bool(
                 math.isfinite(float(stats["max_abs_diff"]))
-                and math.isfinite(float(stats["mean_abs_diff"])))
+                and math.isfinite(float(stats["mean_abs_diff"]))
+                and (self._max_abs_diff is None
+                     or float(stats["max_abs_diff"])
+                     <= self._max_abs_diff))
             out.update({
                 "mirrored": int(mirrored),
                 "canary_errors": int(canary_errors),

@@ -26,7 +26,13 @@ One cycle (`step()`, also run on an interval by `start()`):
    judges (`_gate.CanaryGate`); pass → Production with
    `archive_existing_versions=True` (the registry listeners hot-swap
    every bound endpoint), fail → Archived + a black-box bundle
-   (`obs.dump_blackbox("ct-gate-failure")`).
+   (`obs.dump_blackbox("ct-gate-failure")`). With a FLEET
+   (`ContinuousTrainer(fleet=ReplicaPool(...))`), the promotion runs
+   the staged fleet rollout instead (`fleet/_rollout.py`): the gate
+   judges replica-by-replica, a pass commits the alias after every
+   replica pinned the candidate, and a failed stage auto-rolls-back,
+   archives the candidate, and evicts the diverging replica with its
+   per-replica black-box bundle.
 
 Threading: `step()` may be called from the owner thread or the
 background loop; cycles serialize on `_cycle_lock`, and the stats
@@ -77,6 +83,7 @@ class ContinuousTrainer:
 
     def __init__(self, name: str, source, *,
                  endpoint=None, gate: Optional[CanaryGate] = None,
+                 fleet=None,
                  fit_params: Optional[Dict] = None,
                  checkpoint_dir: Optional[str] = None,
                  warm_severity: Optional[float] = None,
@@ -86,6 +93,11 @@ class ContinuousTrainer:
         self._name = name
         self._source = source
         self._endpoint = endpoint
+        #: a fleet.ReplicaPool (duck-typed: anything with
+        #: promote(version, gate=, X=, y=, candidate_spec=,
+        #: incumbent_spec=)): promotions run the staged fleet rollout
+        #: instead of the single-endpoint gate + alias flip
+        self._fleet = fleet
         self._gate = gate or CanaryGate()
         self._fit_params = dict(fit_params or {})
         self._checkpoint_dir = checkpoint_dir
@@ -210,8 +222,17 @@ class ContinuousTrainer:
             meta = _store.get_registered_model(self._name)
             version = int(meta["latest_version"])
             _store.set_version_stage(self._name, version, "Staging")
-            verdict = self._gate.run(self._endpoint, Xg, yg, new_spec,
-                                     spec)
+            if self._fleet is not None:
+                # the staged fleet rollout judges replica-by-replica
+                # and COMMITS the outcome itself (Production on pass;
+                # rollback + Archived + diverging-replica eviction with
+                # its per-replica blackbox bundle on fail)
+                verdict = self._fleet.promote(
+                    version, gate=self._gate, X=Xg, y=yg,
+                    candidate_spec=new_spec, incumbent_spec=spec)
+            else:
+                verdict = self._gate.run(self._endpoint, Xg, yg,
+                                         new_spec, spec)
             for k in ("rmse_candidate", "rmse_incumbent"):
                 if k in verdict:
                     _tracking.log_metric(f"ct.{k}", verdict[k])
@@ -219,24 +240,33 @@ class ContinuousTrainer:
                                  1.0 if verdict["passed"] else 0.0)
         self._source.advance()
         if verdict["passed"]:
-            _store.set_version_stage(self._name, version, "Production",
-                                     archive_existing_versions=True)
+            if self._fleet is None:
+                _store.set_version_stage(self._name, version,
+                                         "Production",
+                                         archive_existing_versions=True)
             PROFILER.count("ct.promotions")
             if _OBS.enabled:
                 _OBS.emit("ct", "ct.promote", args={
                     "name": self._name, "version": version,
-                    "from": inc_version})
+                    "from": inc_version,
+                    "fleet": self._fleet is not None})
             action = "promoted"
         else:
-            _store.set_version_stage(self._name, version, "Archived")
+            if self._fleet is None:
+                _store.set_version_stage(self._name, version, "Archived")
+                from ..obs import dump_blackbox
+                bundle = dump_blackbox("ct-gate-failure")
+            else:
+                # the rollout already archived the candidate and dumped
+                # the evicted replica's bundle
+                bundle = verdict.get("blackbox")
             PROFILER.count("ct.rollbacks")
-            from ..obs import dump_blackbox
-            bundle = dump_blackbox("ct-gate-failure")
             if _OBS.enabled:
                 _OBS.emit("ct", "ct.rollback", args={
                     "name": self._name, "version": version,
                     "checks": dict(verdict.get("checks") or {}),
-                    "blackbox": bundle})
+                    "blackbox": bundle,
+                    "fleet": self._fleet is not None})
             action = "rolled_back"
         return {"action": action, "refit": mode, "rows": rows,
                 "severity": severity, "version": version,

@@ -1,0 +1,170 @@
+"""Staged fleet rollout: a candidate earns every replica, one at a time.
+
+The single-endpoint promotion (PR 14's `CanaryGate`) judges a Staging
+candidate once and flips the stage alias — an all-or-nothing hot-swap.
+At fleet scale that is the wrong blast radius: a candidate that passes
+one gate window can still diverge under another replica's traffic mix,
+and a bad flip takes every replica down at once. The staged rollout
+bounds the blast radius to ONE replica per stage:
+
+1. candidate must hold Staging (every replica's canary mirror already
+   shadows it — `ServingEndpoint._refresh` tracks the Staging alias);
+2. per stage, the gate runs on the next UNPINNED replica — still
+   serving the incumbent, so its mirror divergence is candidate vs
+   incumbent on live gate traffic (mirror quorum, zero errors, finite
+   + optionally bounded divergence, quality — `ct/_gate.py`);
+3. a passing stage PINS that replica to the candidate
+   (`ServingEndpoint.pin_version`): it serves the candidate while the
+   alias still names the incumbent, so rollback is `unpin()`, not a
+   registry transition;
+4. after every replica passes, the alias commits
+   (`set_version_stage(..., "Production", archive_existing=True)`) and
+   the pins drop — the alias now resolves to what every replica
+   already serves, so nothing swaps;
+5. ANY failed stage auto-rolls-back: every pinned replica unpins (the
+   alias still names the incumbent), the candidate archives, and the
+   replica that failed its gate is EVICTED with its per-replica
+   black-box bundle — the divergence evidence (canary stats, shed
+   receipts, final batches) rides the bundle's ring out of the
+   process.
+
+Promote-during-rollout race: every stage re-resolves the Production
+alias; if it moved underneath the rollout (another promotion landed),
+the rollout ABORTS down the same rollback edge — minus the eviction,
+because nothing diverged; the replicas converge to whatever the alias
+now names, and the candidate archives only if it still holds Staging.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs._recorder import RECORDER as _OBS
+from ..tracking import _store
+from ..utils.profiler import PROFILER
+from ._replica import Replica
+
+#: gate-verdict fields mirrored onto the rollout verdict so a caller
+#: (the ContinuousTrainer) reads one flat shape either way
+_VERDICT_KEYS = ("rows", "mirrored", "canary_errors", "request_errors",
+                 "mean_abs_diff", "max_abs_diff", "rmse_candidate",
+                 "rmse_incumbent", "quality_tol", "checks")
+
+
+def _production_version(name: str) -> Optional[int]:
+    meta = _store.resolve_stage(name, "Production")
+    return None if meta is None else int(meta["version"])
+
+
+def _archive_if_staging(name: str, version: int) -> None:
+    """Archive the candidate ONLY while it still holds Staging — a
+    racing promotion may have moved it, and archiving a version another
+    actor just promoted would be the rollout clobbering the race it
+    lost."""
+    meta = _store.get_model_version(name, version)
+    if meta is not None and meta.get("current_stage") == "Staging":
+        _store.set_version_stage(name, version, "Archived")
+
+
+def staged_rollout(pool, version: int, *, gate=None,
+                   X: Optional[np.ndarray] = None,
+                   y: Optional[np.ndarray] = None,
+                   candidate_spec=None, incumbent_spec=None) -> dict:
+    """Roll `version` (holding Staging) across `pool` replica-by-replica
+    with auto-rollback; returns the flat verdict dict (passed, action,
+    stages, gate fields)."""
+    if X is None or int(np.shape(X)[0]) == 0:
+        raise ValueError(
+            "staged_rollout needs gate traffic (X) — every stage drives "
+            "it through the next replica so the canary mirror can judge "
+            "the candidate against the incumbent")
+    if gate is None:
+        from ..ct._gate import CanaryGate
+        gate = CanaryGate()
+    with pool._rollout_lock:
+        return _run(pool, int(version), gate, np.asarray(X), y,
+                    candidate_spec, incumbent_spec)
+
+
+def _run(pool, version: int, gate, X, y, candidate_spec,
+         incumbent_spec) -> dict:
+    name = pool.name
+    vmeta = _store.get_model_version(name, version)
+    if vmeta is None or vmeta.get("current_stage") != "Staging":
+        raise ValueError(
+            f"rollout candidate {name!r} v{version} must hold Staging "
+            f"(found {None if vmeta is None else vmeta.get('current_stage')!r})"
+            f" — the replicas' canary mirrors shadow the Staging alias")
+    incumbent = _production_version(name)
+    replicas = [r for r in pool.replicas() if r.alive]
+    if not replicas:
+        raise ValueError(f"pool {name!r} has no live replicas to roll "
+                         f"the candidate onto")
+    PROFILER.count("fleet.rollouts")
+    stages: List[dict] = []
+    pinned: List[Replica] = []
+    out: dict = {"version": version, "incumbent": incumbent,
+                 "replicas": len(replicas)}
+    for replica in replicas:
+        verdict = gate.run(replica.endpoint, X, y, candidate_spec,
+                           incumbent_spec)
+        # the promote-during-rollout race check: did the Production
+        # alias move while this stage drove gate traffic?
+        moved = _production_version(name) != incumbent
+        stage = {"rid": replica.rid, "passed": bool(verdict["passed"]),
+                 "aborted_by_transition": moved,
+                 "checks": dict(verdict.get("checks") or {})}
+        stages.append(stage)
+        if _OBS.enabled:
+            _OBS.emit("fleet", "fleet.rollout_stage", args=dict(
+                stage, version=version))
+        if verdict["passed"] and not moved:
+            replica.endpoint.pin_version(version)
+            pinned.append(replica)
+            continue
+        # ---- rollback edge --------------------------------------------
+        for p in pinned:
+            p.endpoint.unpin()
+        _archive_if_staging(name, version)
+        evicted = bundle = None
+        if not moved:
+            # the replica whose gate failed is evicted WITH its bundle;
+            # an alias-move abort evicts nothing (nothing diverged)
+            evicted = replica.rid
+            bundle = pool.evict(replica.rid, reason="rollout-divergence",
+                                blackbox=True)
+        PROFILER.count("fleet.rollout_rollbacks")
+        for k in _VERDICT_KEYS:
+            if k in verdict:
+                out[k] = verdict[k]
+        out.update({"passed": False, "action": "rolled_back",
+                    "stages": stages, "evicted": evicted,
+                    "blackbox": bundle,
+                    "aborted_by_transition": moved})
+        if _OBS.enabled:
+            _OBS.emit("fleet", "fleet.rollout", args={
+                "name": name, "version": version, "passed": False,
+                "evicted": evicted, "blackbox": bundle,
+                "aborted_by_transition": moved})
+        return out
+    # ---- every stage passed: commit, then drop the pins ---------------
+    _store.set_version_stage(name, version, "Production",
+                             archive_existing_versions=True)
+    for p in pinned:
+        p.endpoint.unpin()
+    PROFILER.count("fleet.rollout_promotions")
+    # `verdict` still holds the LAST stage's gate verdict — the flat
+    # fields a caller (the ContinuousTrainer) logs either way
+    for k in _VERDICT_KEYS:
+        if k in verdict:
+            out[k] = verdict[k]
+    out.update({"passed": True, "action": "promoted", "stages": stages,
+                "evicted": None, "blackbox": None,
+                "aborted_by_transition": False})
+    if _OBS.enabled:
+        _OBS.emit("fleet", "fleet.rollout", args={
+            "name": name, "version": version, "passed": True,
+            "stages": len(stages)})
+    return out

@@ -1,0 +1,289 @@
+"""SLO-aware routing and priority admission over a ReplicaPool.
+
+Routing signal: each replica's OWN standing rows (`Replica.pressure`,
+the per-replica `QueuePressure` attribution) ranked lowest-first, with
+the audit-calibrated batch wall (`dispatch.device_ms` — the histogram
+the dispatch audit's attach path feeds from measured device-routed
+walls) turning rows into a predicted drain wall for the health surface
+and route events. Occupancy-hungry micro-batchers want FULL batches, so
+the router packs the least-loaded replica rather than spraying
+round-robin: under light load one replica's batcher coalesces instead
+of N batchers flushing slivers.
+
+Priority admission (`sml.fleet.priorities`, highest first): class i of
+n admits onto a replica only while that replica's standing rows stay
+under (n-i)/n of its queue bound — so as pressure rises the LOWEST
+class sheds first, then the next, and the TOP class preempts the shed
+order entirely: when even its full bound is exhausted it still lands
+on the least-loaded replica's own degradation ladder (host fallback,
+then shed) instead of shedding at the router. An SLO burn-rate past
+1.0 (`obs.slo_report` over the metrics window) halves every non-top
+class's share — the burn-aware shed ladder: spend the error budget on
+the traffic that matters.
+
+Liveness: `submit` returns a `FleetFuture`. If the replica under it
+dies (killed/evicted — `ReplicaGone` in flight, or a drain error on a
+replica the pool marked dead), `result()` RE-ROUTES the request onto a
+live replica (counted `fleet.reroutes`, bounded retries) or sheds —
+never a hung future. `fleet.route` / `fleet.reroute` events carry each
+request's trace id, so a request's causal chain is recoverable through
+the router fan-in: router decision → replica admission span → flush
+fan-in → dispatch.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..conf import GLOBAL_CONF
+from ..obs._metrics import METRICS as _METRICS
+from ..obs._recorder import RECORDER as _OBS
+from ..serving._batcher import RequestShed, ScoreFuture
+from ..utils.profiler import PROFILER, now
+from ._replica import Replica
+
+
+def priority_classes() -> List[str]:
+    """The configured admission classes, highest priority first."""
+    raw = str(GLOBAL_CONF.get("sml.fleet.priorities"))
+    classes = [c.strip() for c in raw.split(",") if c.strip()]
+    return classes or ["normal"]
+
+
+class FleetFuture:
+    """Router-level handle for one request: `result()` resolves the
+    replica-level `ScoreFuture` and, when the replica died underneath
+    it, re-routes through the router instead of surfacing the replica's
+    death. Errors from LIVE replicas propagate — they are real scoring
+    errors, not fleet topology."""
+
+    def __init__(self, router: "Router", X: np.ndarray, cls_idx: int,
+                 priority: str, inner: ScoreFuture,
+                 replica: Optional[Replica], retries: int):
+        self._router = router
+        self._X = X
+        self._cls_idx = cls_idx
+        self.priority = priority
+        self._inner = inner
+        self._replica = replica
+        self._retries = int(retries)
+        self._excluded: Tuple[int, ...] = ()
+
+    @property
+    def trace_id(self) -> Optional[int]:
+        """The CURRENT replica-level request's trace id (a re-route
+        mints a fresh admission — `fleet.reroute` events link old to
+        new)."""
+        return self._inner.trace_id
+
+    @property
+    def replica_id(self) -> Optional[int]:
+        r = self._replica
+        return None if r is None else r.rid
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        deadline = None if timeout is None else now() + float(timeout)
+        while True:
+            remaining = None if deadline is None \
+                else max(deadline - now(), 1e-3)
+            try:
+                return self._inner.result(remaining)
+            except TimeoutError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — re-route gate
+                replica = self._replica
+                if self._retries <= 0 or replica is None or replica.alive:
+                    raise
+                self._retries -= 1
+                self._excluded = self._excluded + (replica.rid,)
+                old_trace = self._inner.trace_id
+                inner, rep = self._router._reroute(
+                    self._X, self._cls_idx, self.priority, self._excluded)
+                if inner is None:
+                    raise RequestShed(
+                        f"replica {replica.rid} died with this request in "
+                        f"flight and no live replica admits priority "
+                        f"{self.priority!r}") from e
+                if _OBS.enabled:
+                    _OBS.emit("fleet", "fleet.reroute", args={
+                        "from_replica": replica.rid,
+                        "to_replica": rep.rid,
+                        "priority": self.priority,
+                        "from_trace": old_trace,
+                        "trace": inner.trace_id})
+                self._inner, self._replica = inner, rep
+
+
+class Router:
+    """Per-request replica choice + priority admission for one pool."""
+
+    #: re-route attempts per request before giving up (each attempt
+    #: excludes every replica the request already died on)
+    REROUTE_RETRIES = 2
+
+    def __init__(self, pool, *, priorities: Optional[Sequence[str]] = None):
+        self._pool = pool
+        self._priorities = (list(priorities) if priorities
+                            else priority_classes())
+        # occupancy observations accumulated per admission and drained
+        # by Autoscaler.step() — the band signal averages real arrival
+        # pressure instead of sampling one instant
+        self._lock = threading.Lock()
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        # (burn_rate, expires_at): the admission ladder reads the SLO
+        # burn on every non-top-class submit — a full slo_report
+        # histogram scan (which also emits a gauge event) per request
+        # would dominate the routing hot path and pollute the ring, so
+        # the value is cached for a short TTL
+        self._burn = (0.0, float("-inf"))
+
+    # ------------------------------------------------------------ signals
+    def _class_index(self, priority: str) -> int:
+        try:
+            return self._priorities.index(priority)
+        except ValueError:
+            raise ValueError(
+                f"unknown priority {priority!r}; configured classes "
+                f"(sml.fleet.priorities, highest first): "
+                f"{self._priorities}") from None
+
+    def default_priority(self) -> str:
+        """The middle class ('normal' of high,normal,low) — unmarked
+        traffic neither preempts nor sheds first."""
+        return self._priorities[len(self._priorities) // 2]
+
+    #: how long one computed burn rate serves admission decisions — a
+    #: band signal over a minutes-wide metrics window does not change
+    #: meaningfully faster than this
+    BURN_TTL_S = 0.5
+
+    def burn_rate(self) -> float:
+        """The serving SLO burn over the metrics window — the admission
+        ladder's tightening signal. Cached for BURN_TTL_S: one windowed
+        histogram scan per tick, not per request."""
+        t = now()
+        with self._lock:
+            value, expires = self._burn
+            if t < expires:
+                return value
+        from .. import obs
+        window = float(GLOBAL_CONF.getInt("sml.obs.metricsWindowSec"))
+        value = float(obs.slo_report(window).get("burn_rate", 0.0))
+        with self._lock:
+            self._burn = (value, t + self.BURN_TTL_S)
+        return value
+
+    def predicted_wait_ms(self, replica: Replica) -> float:
+        """Audit-calibrated drain estimate for a replica's standing
+        queue: batches-to-drain x the median measured device batch wall
+        (`dispatch.device_ms`, fed by the dispatch audit). Falls back
+        to the raw row count (same ranking) before any batch measured."""
+        rows = replica.pressure()
+        hist = _METRICS.histogram("dispatch.device_ms")
+        if hist is None or rows == 0:
+            return float(rows)
+        batch_ms = hist.quantile(
+            0.5, float(GLOBAL_CONF.getInt("sml.obs.metricsWindowSec")))
+        if batch_ms <= 0.0:
+            return float(rows)
+        per_flush = max(replica.endpoint._batcher.max_batch_rows, 1)
+        return math.ceil(rows / per_flush) * float(batch_ms)
+
+    def _class_fraction(self, idx: int) -> float:
+        n = len(self._priorities)
+        frac = (n - idx) / n
+        if idx > 0 and self.burn_rate() > 1.0:
+            frac *= 0.5
+        return frac
+
+    def take_occupancy(self) -> Optional[float]:
+        """Mean fleet occupancy observed at admissions since the last
+        call (None when nothing was admitted) — the autoscaler's
+        windowed band signal."""
+        with self._lock:
+            s, n = self._occ_sum, self._occ_n
+            self._occ_sum, self._occ_n = 0.0, 0
+        return (s / n) if n else None
+
+    # ---------------------------------------------------------- admission
+    def _admit(self, X: np.ndarray, idx: int,
+               excluded: Tuple[int, ...] = ()
+               ) -> Tuple[Optional[ScoreFuture], Optional[Replica]]:
+        n = int(X.shape[0])
+        live = [r for r in self._pool.replicas()
+                if r.alive and r.rid not in excluded]
+        if not live:
+            return None, None
+        rows = [(r.pressure(), r.rid, r) for r in live]
+        rows.sort(key=lambda t: (t[0], t[1]))
+        total_bound = sum(r.queue_bound for r in live)
+        # the POST-admission occupancy this request creates — the band
+        # signal the autoscaler averages (pre-admission sampling would
+        # systematically undercount a filling fleet)
+        occ = (sum(p for p, _, _ in rows) + n) / max(total_bound, 1)
+        with self._lock:
+            self._occ_sum += occ
+            self._occ_n += 1
+        frac = self._class_fraction(idx)
+        for pressure, _, r in rows:
+            if pressure + n <= frac * r.queue_bound:
+                return r.endpoint.submit(X), r
+        if idx == 0:
+            # the top class preempts the shed order: past every bound it
+            # still lands on the least-loaded replica, whose own ladder
+            # (host fallback, then shed) decides — high priority degrades
+            # before it sheds
+            r = rows[0][2]
+            return r.endpoint.submit(X), r
+        return None, None
+
+    def _reroute(self, X: np.ndarray, idx: int, priority: str,
+                 excluded: Tuple[int, ...]
+                 ) -> Tuple[Optional[ScoreFuture], Optional[Replica]]:
+        inner, rep = self._admit(X, idx, excluded)
+        if inner is None:
+            PROFILER.count("fleet.shed")
+            PROFILER.count(f"fleet.shed.{priority}")
+            return None, None
+        PROFILER.count("fleet.reroutes")
+        return inner, rep
+
+    def submit(self, X: np.ndarray,
+               priority: Optional[str] = None) -> FleetFuture:
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        priority = self.default_priority() if priority is None else priority
+        idx = self._class_index(priority)
+        PROFILER.count("fleet.requests")
+        PROFILER.count(f"fleet.requests.{priority}")
+        inner, replica = self._admit(X, idx)
+        if inner is None:
+            PROFILER.count("fleet.shed")
+            PROFILER.count(f"fleet.shed.{priority}")
+            shed = ScoreFuture(int(X.shape[0]))
+            shed._set_error(RequestShed(
+                f"fleet admission refused priority {priority!r}: every "
+                f"live replica is past the class's share of its queue "
+                f"bound"))
+            return FleetFuture(self, X, idx, priority, shed, None, 0)
+        if _OBS.enabled:
+            _OBS.emit("fleet", "fleet.route", args={
+                "replica": replica.rid, "priority": priority,
+                "rows": int(X.shape[0]), "trace": inner.trace_id,
+                "predicted_wait_ms": round(
+                    self.predicted_wait_ms(replica), 3)})
+        return FleetFuture(self, X, idx, priority, inner, replica,
+                           self.REROUTE_RETRIES)
+
+    def score(self, X: np.ndarray, priority: Optional[str] = None,
+              timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(X, priority).result(timeout)

@@ -213,6 +213,12 @@ def _infer_kernel_report() -> Optional[Dict[str, object]]:
     return None if mod is None else mod.kernel_report()
 
 
+def _fleet_report() -> Optional[Dict[str, object]]:
+    import sys
+    mod = sys.modules.get("sml_tpu.fleet")
+    return None if mod is None else mod.fleet_report()
+
+
 def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
     """ONE call, the engine's whole health surface: streaming-metric
     quantiles (serving latency, per-route dispatch walls), the dispatch
@@ -223,6 +229,15 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
     is read from bounded in-memory state."""
     recs = audit_records()
     measured = [r for r in recs if r.measured is not None]
+    # shed counters live in whichever stream was on when they fired
+    # (PROFILER.count forwards to the recorder only while obs is
+    # enabled): max-merge the two, like fleet_report() — both see the
+    # same increments when both are on, so max never double-counts
+    counters = dict(RECORDER.counters())
+    from ..utils.profiler import PROFILER as _PROF
+    for k, v in _PROF.counters().items():
+        if k.startswith("serve.shed"):
+            counters[k] = max(counters.get(k, 0.0), v)
     health = {
         "metrics": METRICS.snapshot(window_s),
         "audit": {
@@ -256,6 +271,22 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         # sys.modules so a health poll never drags jax in — None until
         # the inference module has loaded (nothing scored yet)
         "infer_kernel": _infer_kernel_report(),
+        # serving load-shed attribution (serving/_batcher.py): every
+        # RequestShed path is reason-tagged (overflow / deadline /
+        # closed), so a rising shed rate is attributable to its CAUSE —
+        # a saturated queue sheds differently from a deadline storm
+        "shed": {
+            "total": counters.get("serve.shed", 0.0),
+            "by_reason": {k.split("serve.shed.", 1)[1]: v
+                          for k, v in counters.items()
+                          if k.startswith("serve.shed.")},
+        },
+        # multi-replica serving fleet (sml_tpu/fleet): per-pool replica
+        # tables (per-replica standing rows / occupancy / pinned
+        # version), shed-by-priority-class, autoscale + rollout
+        # receipts. Read lazily off sys.modules like infer_kernel —
+        # None until a pool exists
+        "fleet": _fleet_report(),
     }
     if RECORDER.enabled:
         RECORDER.emit("health", "health.snapshot", args={

@@ -29,6 +29,12 @@ didn't eyeball PERF.md closely enough. `compare()` is the machine check:
   vanish or flip — a loop that stops promoting, stops warm-starting,
   or starts refitting on iid traffic is a regression even when every
   wall clock holds;
+- **serving-fleet proofs**: the sidecar `fleet` block's liveness
+  (zero hung futures), scale-band, staged-rollout (clean promote /
+  divergent rollback with the evicted replica's black-box bundle),
+  priority-shed-ordering, and router-fan-in-trace proofs must not
+  vanish or flip, and per-class p99/shed-rate must hold within
+  load-number tolerances;
 - **drift proofs**: the sidecar `drift` block's detection proof
   (injected shift FLAGGED with the moved features named), its
   no-false-positive proof (iid holdout CLEAN), and the baseline
@@ -118,6 +124,7 @@ def normalize(doc: dict) -> dict:
             "drift": doc.get("drift"),
             "lint": doc.get("lint"),
             "ct": doc.get("ct"),
+            "fleet": doc.get("fleet"),
             "shape": "sidecar",
         }
     # driver-record shape: {"parsed": {headline...}, "tail": "stdout..."}
@@ -147,6 +154,7 @@ def normalize(doc: dict) -> dict:
         "drift": doc.get("drift"),
         "lint": doc.get("lint"),
         "ct": doc.get("ct"),
+        "fleet": doc.get("fleet"),
         "shape": "record",
     }
 
@@ -534,6 +542,91 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                     "regression",
                     "iid control stream now triggers refits — the "
                     "drift trigger false-positives"))
+
+    # ---- fleet block (serving-fleet closed-loop proofs)
+    bfl, cfl = base.get("fleet"), cand.get("fleet")
+    if bfl and not cfl and cand.get("shape") != "record":
+        # coverage rule, like the kernel/scale/drift/ct blocks: a
+        # sidecar candidate missing the block lost the fleet gate
+        # (bench.py carries it across plain suite runs); driver records
+        # can never carry it
+        reg.append(_finding(
+            "missing-fleet-block", "fleet", 1.0, 0.0, 0.0, "regression",
+            "serving-fleet block present in base, absent in candidate"))
+    if bfl and cfl:
+        # a hung future is a liveness bug, not a perf number: 0 → N flags
+        if int(bfl.get("hung_futures", -1)) == 0:
+            checked += 1
+            if int(cfl.get("hung_futures", -1)) != 0:
+                reg.append(_finding(
+                    "fleet-liveness", "hung_futures", 0.0,
+                    float(cfl.get("hung_futures", -1)), 0.0,
+                    "regression",
+                    "requests hung instead of resolving (re-route or "
+                    "shed) — the never-a-hung-future contract broke"))
+
+        def _dig(doc, path):
+            cur = doc
+            for p in path:
+                cur = cur.get(p) if isinstance(cur, dict) else None
+            return cur
+
+        for path, note in (
+                (("scale", "up_ok"),
+                 "occupancy scale-up proof lost — the autoscaler no "
+                 "longer adds replicas under load"),
+                (("scale", "down_ok"),
+                 "scale-down proof lost — the idle fleet no longer "
+                 "retires to its floor"),
+                (("rollout", "clean", "passed"),
+                 "clean staged rollout no longer promotes"),
+                (("rollout", "rollback", "rolled_back"),
+                 "divergent rollout no longer auto-rolls-back — the "
+                 "fleet would ship the bad candidate"),
+                (("rollout", "rollback", "blackbox_on_disk"),
+                 "evicted replica's black-box bundle proof lost"),
+                (("priority_order_ok",),
+                 "priority shed ladder no longer ordered (low must "
+                 "shed first, high never)"),
+                (("trace", "fanin_ok"),
+                 "per-request trace ids no longer recoverable through "
+                 "the router fan-in")):
+            if _dig(bfl, path):
+                checked += 1
+                if _dig(cfl, path) is not True:
+                    reg.append(_finding(
+                        "fleet-proof", ".".join(path), 1.0, 0.0, 0.0,
+                        "regression", note))
+        # per-class latency/shed: load numbers — p99 at the serving
+        # tolerance, shed rate noise-aware (absolute floor + half the
+        # base rate of slack)
+        bp = bfl.get("priority") or {}
+        cp = cfl.get("priority") or {}
+        for cls in sorted(bp):
+            ce = cp.get(cls)
+            if not ce:
+                continue
+            bv, cv = bp[cls].get("p99_ms"), ce.get("p99_ms")
+            if bv and cv:
+                checked += 1
+                rel = float(cv) / float(bv) - 1.0
+                if rel > SERVE_TOL:
+                    reg.append(_finding(
+                        "fleet-latency", f"{cls}:p99_ms", float(bv),
+                        float(cv), SERVE_TOL, "regression"))
+                elif rel < -SERVE_TOL:
+                    imp.append(_finding(
+                        "fleet-latency", f"{cls}:p99_ms", float(bv),
+                        float(cv), SERVE_TOL, "improvement"))
+            br = float(bp[cls].get("shed_rate", 0.0))
+            cr = float(ce.get("shed_rate", 0.0))
+            checked += 1
+            if cr > br + max(0.1, 0.5 * br):
+                reg.append(_finding(
+                    "fleet-shed-rate", f"{cls}:shed_rate", br, cr,
+                    0.5, "regression",
+                    "per-class shed rate grew past the noise-aware "
+                    "slack"))
 
     # ---- lint block (static-analysis gate receipts)
     bln, cln = base.get("lint"), cand.get("lint")

@@ -89,6 +89,13 @@ COUNTERS = {
     "serve.requests", "serve.rows",
     "serve.batches", "serve.batch_rows", "serve.batch_pad_rows",
     "serve.shed", "serve.expired", "serve.host_routed",
+    # reason-tagged shed attribution next to the serve.shed total:
+    # serve.shed.overflow (queue saturated, host fallback off) /
+    # serve.shed.deadline (expired before its batch flushed) /
+    # serve.shed.closed (submitted to a closing batcher) — so
+    # engine_health()["shed"] and the fleet router see shed rate per
+    # CAUSE, not one undifferentiated count
+    "serve.shed.*",
     "serve.hot_swap",
     "serve.model_cache_hit", "serve.model_cache_miss",
     "serve.model_cache_evict_bytes",
@@ -110,6 +117,17 @@ COUNTERS = {
     # / ct.cycle_error (background-loop cycles that raised — the loop
     # survives, the failure is visible)
     "ct.*",
+    # multi-replica serving fleet (sml_tpu/fleet): fleet.requests /
+    # fleet.requests.<class> (router admissions by priority class) /
+    # fleet.shed + fleet.shed.<class> (router-level priority sheds) /
+    # fleet.reroutes (requests re-routed off a dead replica) /
+    # fleet.replicas_started / fleet.replicas_evicted /
+    # fleet.scale_up / fleet.scale_down (autoscaler band actions) /
+    # fleet.autoscale_error (background steps that raised — the loop
+    # survives, the failure is visible) / fleet.rollouts /
+    # fleet.rollout_promotions / fleet.rollout_rollbacks (staged
+    # rollout outcomes)
+    "fleet.*",
     # registry stage-transition listeners that RAISED (the commit
     # landed; later listeners still fired): a dead subscriber must be
     # visible in the counters, like serve.canary_error
@@ -135,6 +153,10 @@ GAUGES = {
                           # multiple of its noise-aware threshold) and
                           # the flagged-feature count, stamped by every
                           # DriftMonitor.report()
+    "fleet.*",            # fleet.replicas (live replica count, stamped
+                          # on every pool topology change) /
+                          # fleet.occupancy (the autoscaler's band
+                          # signal at each step)
 }
 
 EVENTS = {
@@ -179,6 +201,16 @@ EVENTS = {
     # ct.promote (canary gate passed — Production moved), ct.rollback
     # (gate failed — candidate archived, blackbox bundle path in args)
     "ct.*",
+    # multi-replica serving fleet (sml_tpu/fleet): fleet.route (one
+    # router decision: replica, priority class, the request's trace id
+    # — the router half of the fan-in chain) / fleet.reroute (a
+    # request re-routed off a dead replica, old + new trace ids) /
+    # fleet.replica_start / fleet.replica_evict (teardown receipts,
+    # blackbox bundle path in args) / fleet.scale (autoscaler band
+    # action receipts) / fleet.rollout_stage (one replica's gate
+    # verdict during a staged rollout) / fleet.rollout (the rollout's
+    # final promote/rollback verdict)
+    "fleet.*",
 }
 
 # streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency

@@ -209,7 +209,7 @@ class _ObservedRates:
 OBSERVED_HOST = _ObservedRates()
 
 
-class _QueuePressure:
+class QueuePressure:
     """Rows currently queued for (or in flight on) the device by online
     serving — the dispatcher's backpressure signal. The serving
     micro-batcher feeds it (`add` at admission, `sub` when a batch
@@ -218,19 +218,33 @@ class _QueuePressure:
     route instead of queueing behind it. Deliberately NOT a term in
     `device_time` — fits price a single dispatch, while serving pressure
     is a property of the standing queue, and mixing the two would let a
-    transient burst reroute long training jobs."""
+    transient burst reroute long training jobs.
 
-    def __init__(self) -> None:
+    `parent` chains per-replica queues into the process-wide signal:
+    a fleet replica's own `QueuePressure(parent=DEVICE_QUEUE)` gives the
+    router per-replica attribution (this replica's standing rows, not
+    the fleet total) while every add/sub still reaches the one
+    dispatcher signal — the device tunnel is shared no matter how many
+    batchers feed it."""
+
+    def __init__(self, parent: "Optional[QueuePressure]" = None) -> None:
         self._lock = threading.Lock()
         self._rows = 0
+        self._parent = parent
 
     def add(self, rows: int) -> None:
         with self._lock:
             self._rows += int(rows)
+        parent = self._parent
+        if parent is not None:
+            parent.add(rows)
 
     def sub(self, rows: int) -> None:
         with self._lock:
             self._rows = max(0, self._rows - int(rows))
+        parent = self._parent
+        if parent is not None:
+            parent.sub(rows)
 
     def rows(self) -> int:
         with self._lock:
@@ -238,7 +252,7 @@ class _QueuePressure:
 
 
 #: process-wide device-queue pressure (one device tunnel per process)
-DEVICE_QUEUE = _QueuePressure()
+DEVICE_QUEUE = QueuePressure()
 
 
 import contextlib as _contextlib

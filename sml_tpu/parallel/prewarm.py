@@ -62,7 +62,12 @@ _MANIFEST_VERSION = 1
 _lock = threading.Lock()
 _state: Dict[str, Any] = {"path": None, "entries": None}
 _tls = threading.local()  # replay re-entrancy guard
-_ran = {"done": False}
+#: replay guard, keyed per (manifest path, mesh signature) — NOT once
+#: per process: N in-process fleet replicas share one warm set of
+#: program caches only while they share BOTH the manifest and the live
+#: mesh, so replica 2..N skip (counted prewarm.replica_skip) while a
+#: re-pointed compile-cache dir or a reshaped mesh warms again
+_ran: Dict[Any, bool] = {}
 
 #: kind -> rebuilder(meta) — populated by tree_impl / inference /
 #: _staging at import; prewarm() imports them before replaying.
@@ -126,6 +131,12 @@ def manifest_path() -> Optional[str]:
     if not d:
         return None
     return os.path.join(d, "prewarm_manifest.json")
+
+
+def _guard_key() -> tuple:
+    """The replay-guard identity: what must match for a second replica's
+    warm caches to genuinely be this replica's warm caches."""
+    return (manifest_path(), tuple(_mesh_sig()))
 
 
 def _mesh_sig() -> list:
@@ -292,7 +303,9 @@ def prewarm(workers: Optional[int] = None) -> dict:
     a time — serial_s / wall_s is the overlap the pool bought."""
     # rebuilders live in the modules that own the program caches
     from ..ml import _staging, inference, tree_impl  # noqa: F401
-    _ran["done"] = True
+    key = _guard_key()
+    with _lock:
+        _ran[key] = True
     path = manifest_path()
     entries = _load(path) if path else {}
     sig = _mesh_sig()
@@ -325,19 +338,26 @@ def prewarm(workers: Optional[int] = None) -> dict:
 
 
 def maybe_prewarm(block: bool = False) -> Optional[object]:
-    """The opt-in process-start hook (bench warmup, serving endpoint
-    load): replay the manifest once per process when
-    `sml.prewarm.enabled` is set — in a background thread by default, so
-    model loads overlap the warmup instead of waiting on it."""
+    """The opt-in replica-start hook (bench warmup, serving endpoint /
+    fleet replica load): replay the manifest once per (manifest, mesh)
+    when `sml.prewarm.enabled` is set — in a background thread by
+    default, so model loads overlap the warmup instead of waiting on it.
+    A second in-process replica under the SAME manifest and mesh shares
+    the first replica's warm program caches, so it skips (counted
+    `prewarm.replica_skip`); a replica starting after the compile-cache
+    dir was re-pointed or the mesh reshaped warms its genuinely cold
+    world instead of inheriting a stale guard."""
     if not GLOBAL_CONF.getBool("sml.prewarm.enabled"):
         return None
+    key = _guard_key()
     with _lock:
-        # claim BEFORE spawning: two endpoints constructed back-to-back
+        # claim BEFORE spawning: two replicas constructed back-to-back
         # must not both launch a replay (the thread sets nothing until it
         # is scheduled — check-then-act on the thread's own flag races)
-        if _ran["done"]:
+        if _ran.get(key):
+            PROFILER.count("prewarm.replica_skip")
             return None
-        _ran["done"] = True
+        _ran[key] = True
     if block:
         return prewarm()
     t = threading.Thread(target=prewarm, daemon=True, name="sml-prewarm")

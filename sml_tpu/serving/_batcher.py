@@ -130,10 +130,17 @@ class MicroBatcher:
                  timeout_millis: Optional[int] = None,
                  host_fallback: Optional[bool] = None,
                  observer: Optional[Callable] = None,
+                 queue: Optional[dispatch.QueuePressure] = None,
                  start: bool = True):
         self._score_block = score_block
         self._host_score = host_score
         self._observer = observer
+        # the pressure signal this batcher's admissions feed and its
+        # saturation check reads: the process-wide DEVICE_QUEUE by
+        # default, or a per-replica QueuePressure(parent=DEVICE_QUEUE)
+        # so a fleet router sees THIS batcher's standing rows instead of
+        # one global number every replica pollutes
+        self._queue = dispatch.DEVICE_QUEUE if queue is None else queue
         conf = GLOBAL_CONF
         self.max_batch_rows = max(int(
             conf.getInt("sml.serve.maxBatchRows")
@@ -199,22 +206,26 @@ class MicroBatcher:
         deadline = (now() + self._timeout_s) if self._timeout_s else None
         pending = _Pending(X, deadline)
         with self._cond:
-            saturated = self._closed or \
-                dispatch.DEVICE_QUEUE.rows() + n > self.queue_rows
+            closed = self._closed
+            saturated = closed or \
+                self._queue.rows() + n > self.queue_rows
             if not saturated:
-                dispatch.DEVICE_QUEUE.add(n)
+                self._queue.add(n)
                 self._q.append(pending)
                 self._queued_rows += n
                 queued = self._queued_rows
                 self._cond.notify()
         if saturated:
-            return self._overflow(pending)
+            return self._overflow(pending, closed)
         if _OBS.enabled:
             _OBS.gauge("serve.queue_rows", float(queued))
         return pending.future
 
-    def _overflow(self, pending: _Pending) -> ScoreFuture:
-        """Degradation ladder past admission: host route, else shed."""
+    def _overflow(self, pending: _Pending, closed: bool) -> ScoreFuture:
+        """Degradation ladder past admission: host route, else shed.
+        Every shed is reason-tagged (`serve.shed.<reason>` next to the
+        `serve.shed` total) so engine_health() and a fleet router see
+        shed rate PER CAUSE, not one undifferentiated count."""
         if self._host_fallback:
             PROFILER.count("serve.host_routed")
             try:
@@ -228,9 +239,12 @@ class MicroBatcher:
             except BaseException as e:  # noqa: BLE001 — future carries it
                 pending.future._set_error(e)
             return pending.future
+        reason = "closed" if closed else "overflow"
         PROFILER.count("serve.shed")
+        PROFILER.count(f"serve.shed.{reason}")
         pending.future._set_error(RequestShed(
-            f"serving queue saturated ({dispatch.DEVICE_QUEUE.rows()} rows "
+            "batcher is closed" if closed else
+            f"serving queue saturated ({self._queue.rows()} rows "
             f"queued toward the device, bound {self.queue_rows}) and host "
             f"fallback is off"))
         return pending.future
@@ -294,12 +308,14 @@ class MicroBatcher:
 
     def _run_batch(self, batch: List[_Pending]) -> None:
         t = now()
+        queue = self._queue
         live: List[_Pending] = []
         for p in batch:
             if p.deadline is not None and t > p.deadline:
                 PROFILER.count("serve.expired")
                 PROFILER.count("serve.shed")
-                dispatch.DEVICE_QUEUE.sub(p.n)
+                PROFILER.count("serve.shed.deadline")
+                queue.sub(p.n)
                 p.future._set_error(RequestShed(
                     "request exceeded sml.serve.requestTimeoutMillis "
                     "before its batch flushed"))
@@ -370,4 +386,4 @@ class MicroBatcher:
                 p.future._set_error(e)
         finally:
             _WATCHDOG.close(ticket)
-            dispatch.DEVICE_QUEUE.sub(total)
+            queue.sub(total)

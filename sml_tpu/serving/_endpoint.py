@@ -71,6 +71,7 @@ class ServingEndpoint:
         self._swap_lock = threading.RLock()
         self._scorer = None
         self._version: Optional[int] = None
+        self._pinned: Optional[int] = None
         self._staging_scorer = None
         self._staging_version: Optional[int] = None
         self._canary_fraction = canary_fraction
@@ -111,7 +112,7 @@ class ServingEndpoint:
             return  # keep serving the last good version (alias emptied)
         version = meta["version"]
         with self._swap_lock:
-            if version != self._version:
+            if self._pinned is None and version != self._version:
                 self._scorer = self._cache.get(
                     self._name, version,
                     lambda: _load_scorer(self._name, version))
@@ -125,13 +126,26 @@ class ServingEndpoint:
         if self._stage != "Staging":
             smeta = _store.resolve_stage(self._name, "Staging")
             with self._swap_lock:
+                changed = False
                 if smeta is None:
+                    changed = self._staging_version is not None
                     self._staging_scorer = self._staging_version = None
                 elif smeta["version"] != self._staging_version:
                     v = smeta["version"]
                     self._staging_scorer = self._cache.get(
                         self._name, v, lambda: _load_scorer(self._name, v))
                     self._staging_version = v
+                    changed = True
+            if changed:
+                # the divergence stats describe the CURRENT canary
+                # target: a new candidate entering Staging starts from
+                # zero — a past candidate's running max must not poison
+                # every later gate on this endpoint (the max is folded
+                # monotonically and can never come back down)
+                with self._canary_lock:
+                    self._canary = {"mirrored": 0, "rows": 0,
+                                    "sum_abs_diff": 0.0,
+                                    "max_abs_diff": 0.0, "errors": 0}
         self._install_drift()
 
     def _drift_key(self) -> str:
@@ -195,6 +209,44 @@ class ServingEndpoint:
 
     def current_version(self) -> Optional[int]:
         return self._version
+
+    # ----------------------------------------------------------- pinning
+    def pin_version(self, version: int) -> None:
+        """Pin the PRIMARY scorer to an explicit registry version — the
+        per-replica switch a staged fleet rollout makes while the stage
+        alias still points at the incumbent. Stage-transition listeners
+        keep firing (the Staging canary target still tracks) but the
+        primary no longer follows the alias until `unpin()`; a pinned
+        swap emits the same `serve.swap` receipt as a hot-swap, tagged
+        pinned=True."""
+        version = int(version)
+        with self._swap_lock:
+            self._pinned = version
+            if version != self._version:
+                self._scorer = self._cache.get(
+                    self._name, version,
+                    lambda: _load_scorer(self._name, version))
+                old, self._version = self._version, version
+                PROFILER.count("serve.hot_swap")
+                if _OBS.enabled:
+                    _OBS.emit("serve", "serve.swap", args={
+                        "name": self._name, "stage": self._stage,
+                        "from": old, "to": version, "pinned": True})
+        self._install_drift()
+
+    def unpin(self) -> None:
+        """Drop the pin and fall back to stage-alias resolution (the
+        rollout's rollback edge: the replica re-resolves the incumbent
+        the alias still names)."""
+        with self._swap_lock:
+            if self._pinned is None:
+                return
+            self._pinned = None
+        self._refresh()
+
+    def pinned_version(self) -> Optional[int]:
+        with self._swap_lock:
+            return self._pinned
 
     # -------------------------------------------------------------- scoring
     def _score_device(self, X: np.ndarray) -> np.ndarray:
@@ -312,6 +364,7 @@ class ServingEndpoint:
             "name": self._name,
             "stage": self._stage,
             "version": self._version,
+            "pinned": self._pinned,
             "staging_version": self._staging_version,
             "queued_rows": self._batcher.queued_rows(),
             "max_batch_rows": self._batcher.max_batch_rows,

@@ -176,22 +176,39 @@ def test_prewarm_skips_foreign_mesh_entries(prewarm_env, reg_frames):
     assert stats["skipped"] == len(man["entries"])
 
 
-def test_maybe_prewarm_is_opt_in_and_once(prewarm_env, monkeypatch):
+def test_maybe_prewarm_is_opt_in_and_guarded_per_manifest_mesh(
+        prewarm_env, monkeypatch, tmp_path):
+    """The replay guard is keyed per (manifest, mesh) — NOT once per
+    process: replica 2..N under the same warm caches skip (counted
+    prewarm.replica_skip), while a re-pointed compile-cache dir is a
+    genuinely cold world that warms again."""
     from sml_tpu.parallel import prewarm
+    from sml_tpu.utils.profiler import PROFILER
 
     calls = []
     monkeypatch.setattr(prewarm, "prewarm", lambda **kw: calls.append(1))
-    monkeypatch.setitem(prewarm._ran, "done", False)
+    monkeypatch.setattr(prewarm, "_ran", {})
     assert prewarm.maybe_prewarm(block=True) is None  # conf off: no-op
     GLOBAL_CONF.set("sml.prewarm.enabled", True)
     try:
         prewarm.maybe_prewarm(block=True)
         assert calls == [1]
-        # once per process — the claim happens in maybe_prewarm itself
-        # (not in the replay thread), so back-to-back endpoint
-        # constructions cannot both launch a replay
-        assert prewarm._ran["done"] is True
+        # the claim happens in maybe_prewarm itself (not in the replay
+        # thread), so back-to-back replica constructions cannot both
+        # launch a replay; the shared-warm-cache skip is COUNTED
+        assert prewarm._ran.get(prewarm._guard_key()) is True
+        skip0 = PROFILER.counters().get("prewarm.replica_skip", 0.0)
         assert prewarm.maybe_prewarm(block=True) is None
+        assert PROFILER.counters().get("prewarm.replica_skip", 0.0) \
+            == skip0 + 1
+        assert calls == [1]
+        # a re-pointed compile cache = a different manifest = cold
+        # caches for this key: the guard must NOT carry over
+        other = tmp_path / "other-cache"
+        GLOBAL_CONF.set("sml.compile.cacheDir", str(other))
+        prewarm.maybe_prewarm(block=True)
+        assert calls == [1, 1]
     finally:
         GLOBAL_CONF.unset("sml.prewarm.enabled")
-    assert calls == [1]
+        GLOBAL_CONF.set("sml.compile.cacheDir", prewarm_env)
+    assert calls == [1, 1]

@@ -311,6 +311,7 @@ def test_over_capacity_burst_sheds_without_deadlock(registered_pair,
     m1, _, X = registered_pair
     scorer = DeviceScorer(m1)
     shed0 = _counter("serve.shed")
+    over0 = _counter("serve.shed.overflow")
     b = MicroBatcher(scorer.score_block, max_batch_rows=16, queue_rows=8,
                      host_fallback=False, start=False)
     futs = [b.submit(X[:1]) for _ in range(20)]
@@ -318,6 +319,8 @@ def test_over_capacity_burst_sheds_without_deadlock(registered_pair,
     # needed, nothing blocks
     shed = [f for f in futs if f.done()]
     assert len(shed) == 12 and _counter("serve.shed") - shed0 == 12
+    # reason-tagged next to the total: the cause is attributable
+    assert _counter("serve.shed.overflow") - over0 == 12
     for f in shed:
         with pytest.raises(RequestShed):
             f.result(1)
@@ -411,6 +414,32 @@ def test_canary_mirrors_to_staging_and_records_divergence(registered_pair,
         # v1 and v2 were trained on different targets: divergence is real
         assert stats["mean_abs_diff"] > 0.1
         assert stats["max_abs_diff"] >= stats["mean_abs_diff"]
+
+
+def test_canary_stats_reset_on_staging_change(registered_pair,
+                                              profiler_on):
+    """A new candidate entering (or leaving) Staging re-arms the
+    divergence accumulator: the running max is folded monotonically, so
+    a past candidate's divergence must not poison every later gate on
+    this endpoint (the fleet rollout's max_abs_diff bound reads it)."""
+    import time
+    m1, m2, X = registered_pair
+    mlflow.MlflowClient().transition_model_version_stage(
+        "serve-model", 2, stage="Staging")
+    with ServingEndpoint("serve-model", "Production", canary_fraction=1.0,
+                         flush_micros=200) as ep:
+        for _ in range(3):
+            ep.score(X[:2], timeout=30)
+        for _ in range(100):
+            if ep.canary_stats()["mirrored"] >= 3:
+                break
+            time.sleep(0.02)
+        assert ep.canary_stats()["max_abs_diff"] > 0
+        # the candidate leaves Staging: stats describe nothing now
+        _store.set_version_stage("serve-model", 2, "Archived")
+        stats = ep.canary_stats()
+        assert stats["mirrored"] == 0 and stats["max_abs_diff"] == 0.0
+        assert stats["staging_version"] is None
 
 
 def test_canary_fraction_paces_mirroring(registered_pair):
