@@ -2648,6 +2648,11 @@ def run_graftlint() -> int:
         "violations_by_rule": by_rule,
         "suppressed_pragma": report.n_suppressed_pragma,
         "suppressed_baseline": report.n_suppressed_baseline,
+        # per-rule check() wall time (ms) — a rule whose cost quietly
+        # balloons shows up in the sidecar and the lint.* counters
+        "rule_times_ms": {n: round(t * 1000.0, 3)
+                          for n, t in sorted(
+                              getattr(report, "rule_times", {}).items())},
     }
     return 0 if report.clean else 1
 
@@ -2668,6 +2673,8 @@ def _emit_lint_counters() -> None:
                    float(LINT_STATS["suppressed_baseline"]))
     for rule_name, n in sorted(LINT_STATS["violations_by_rule"].items()):
         PROFILER.count(f"lint.rule.{rule_name}", float(n))
+    for rule_name, ms in LINT_STATS.get("rule_times_ms", {}).items():
+        PROFILER.count(f"lint.rule_ms.{rule_name}", float(ms))
 
 
 if __name__ == "__main__":

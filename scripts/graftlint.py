@@ -26,6 +26,7 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -58,6 +59,11 @@ def main(argv=None) -> int:
     parser.add_argument("--rule", action="append", default=None,
                         metavar="NAME",
                         help="run only this rule (repeatable)")
+    parser.add_argument("--changed-only", metavar="GIT_REF", default=None,
+                        help="report only violations in files changed vs "
+                             "GIT_REF (plus untracked files); the whole "
+                             "tree is still analysed — cross-file rules "
+                             "need it — only the REPORT is filtered")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore .graftlint-baseline.json")
     parser.add_argument("--update-baseline", action="store_true",
@@ -89,6 +95,22 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    if args.changed_only is not None:
+        try:
+            changed = _changed_files(args.root, args.changed_only)
+        except Exception as e:  # bad ref / not a git tree: contract = 2
+            print(f"graftlint: --changed-only: {e}", file=sys.stderr)
+            return 2
+        report = lint.Report(
+            [v for v in report.violations if v.path in changed],
+            report.rule_names, report.n_files,
+            n_suppressed_pragma=report.n_suppressed_pragma,
+            n_suppressed_baseline=report.n_suppressed_baseline,
+            rule_times=report.rule_times,
+            suppressed_detail=[(v, how) for v, how
+                               in report.suppressed_detail
+                               if v.path in changed])
+
     if args.update_baseline:
         baseline_mod = sys.modules[f"{PKG_NAME}.baseline"]
         path = os.path.join(args.root, baseline_mod.DEFAULT_BASENAME)
@@ -104,15 +126,40 @@ def main(argv=None) -> int:
             "clean": report.clean,
             "rules": report.rule_names,
             "files": report.n_files,
+            "changed_only": args.changed_only,
             "suppressed": {"pragma": report.n_suppressed_pragma,
                            "baseline": report.n_suppressed_baseline},
+            "rule_times": {n: round(t, 6)
+                           for n, t in sorted(report.rule_times.items())},
             "violations": [{"rule": v.rule, "path": v.path, "line": v.line,
-                            "message": v.message, "snippet": v.snippet}
+                            "message": v.message, "snippet": v.snippet,
+                            "status": "active"}
                            for v in report.violations],
+            "suppressed_violations": [
+                {"rule": v.rule, "path": v.path, "line": v.line,
+                 "message": v.message, "status": how}
+                for v, how in report.suppressed_detail],
         }, indent=1))
     else:
         print(report.format())
     return 0 if report.clean else 1
+
+
+def _changed_files(root, ref):
+    """Repo-relative paths changed vs `ref`, plus untracked files (a
+    brand-new file must still be lintable pre-commit). Raises on any git
+    failure — the caller maps that to exit code 2."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                              text=True, timeout=30)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip()
+                               or f"`{' '.join(cmd)}` failed")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 if __name__ == "__main__":

@@ -9,8 +9,9 @@ in-memory fixture projects).
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
 from . import pragmas as pragmas_mod
@@ -25,6 +26,13 @@ class Report:
     n_files: int
     n_suppressed_pragma: int = 0
     n_suppressed_baseline: int = 0
+    #: per-rule check() wall time, seconds (empty when a caller built the
+    #: Report by hand — both fields default for back-compat)
+    rule_times: Dict[str, float] = field(default_factory=dict)
+    #: the individual suppressed violations with how each was silenced
+    #: ("pragma" | "baseline") — the --json per-violation status surface
+    suppressed_detail: List[Tuple[Violation, str]] = \
+        field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -68,8 +76,11 @@ def run(root: Optional[str] = None, project: Optional[Project] = None,
             raw.append(Violation(
                 "syntax-error", f.rel, f.parse_error.lineno or 0,
                 f"file does not parse: {f.parse_error.msg}"))
+    rule_times: Dict[str, float] = {}
     for name in names:
+        t0 = time.monotonic()
         raw.extend(RULES[name].check(project))
+        rule_times[name] = time.monotonic() - t0
 
     # stamp the snippet fingerprint (rules may leave it empty)
     stamped: List[Violation] = []
@@ -84,6 +95,8 @@ def run(root: Optional[str] = None, project: Optional[Project] = None,
     kept, pragma_meta = pragmas_mod.apply(project.files, stamped,
                                           active_rules=names)
     n_pragma = len(stamped) - len(kept)
+    kept_ids = {id(v) for v in kept}
+    suppressed = [(v, "pragma") for v in stamped if id(v) not in kept_ids]
 
     base_meta: List[Violation] = []
     n_base = 0
@@ -93,11 +106,17 @@ def run(root: Optional[str] = None, project: Optional[Project] = None,
                                          baseline_mod.DEFAULT_BASENAME)
         entries = baseline_mod.load(baseline_path)
         before = len(kept)
-        kept, base_meta = baseline_mod.apply(kept, entries,
-                                             active_rules=names)
-        n_base = before - len(kept)
+        after, base_meta = baseline_mod.apply(kept, entries,
+                                              active_rules=names)
+        n_base = before - len(after)
+        after_ids = {id(v) for v in after}
+        suppressed.extend((v, "baseline") for v in kept
+                          if id(v) not in after_ids)
+        kept = after
 
     final = sorted(kept + pragma_meta + base_meta,
                    key=lambda v: (v.path, v.line, v.rule, v.message))
+    suppressed.sort(key=lambda p: (p[0].path, p[0].line, p[0].rule))
     return Report(final, names, len(project.files),
-                  n_suppressed_pragma=n_pragma, n_suppressed_baseline=n_base)
+                  n_suppressed_pragma=n_pragma, n_suppressed_baseline=n_base,
+                  rule_times=rule_times, suppressed_detail=suppressed)

@@ -3,6 +3,7 @@ in `core.RULES`; add a new rule by dropping a module here that uses the
 `@rule(name, doc)` decorator and importing it below (see
 docs/LINT.md "Adding a rule")."""
 
-from . import (conf_keys, dispatch_bypass, donation,  # noqa: F401
-               host_sync, lock_order, race_check_use, race_shared_write,
+from . import (collective_axis, compile_inputs, conf_keys,  # noqa: F401
+               dispatch_bypass, divergent_collective, donation, host_sync,
+               key_fold, lock_order, race_check_use, race_shared_write,
                sharded_staging, taxonomy, wallclock)

@@ -1,0 +1,86 @@
+"""collective-axis-discipline: every collective must name a mesh axis
+that exists and must run where an axis is bound.
+
+A `coll.psum(x, axis="typo")` traces fine and fails at run time deep
+inside jit; a collective in code no shard-mapped region reaches has no
+axis bound at all and either crashes or (under pmap fallback) silently
+reduces over the wrong group. Both legs read the traced-region and
+mesh/axis models from `lint/traced.py`:
+
+* an axis-name literal at a collective site that no `parallel/mesh.py`
+  constant or `Mesh(...)` construction declares;
+* a collective site whose enclosing function is module-level code, is
+  never traced, or is traced but not reachable from any shard-mapping
+  seed (`shard_map` / `data_parallel` / `pmap`).
+
+The `parallel/collectives.py` wrapper bodies themselves are exempt
+(they compose each other by design), as are sites whose axis argument
+stays dynamic (a parameter — the wrapper-default pattern)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import traced
+from ..core import Violation, rule
+from ..project import Project
+
+
+@rule(
+    "collective-axis-discipline",
+    "Collectives must use declared mesh axes inside shard-mapped regions",
+)
+def check(project: Project) -> List[Violation]:
+    analysis = traced.analyze(project)
+    out: List[Violation] = []
+    declared = analysis.declared_axes
+    for site in analysis.collectives:
+        # wrapper composition: psum_scalars -> psum etc.
+        if site.fn_name in traced.COLLECTIVE_OPS:
+            continue
+        if site.axis_kind == "literal" and declared \
+                and site.axis not in declared:
+            out.append(Violation(
+                rule="collective-axis-discipline",
+                path=site.rel,
+                line=site.lineno,
+                message=(
+                    f"collective `{site.op}` names axis '{site.axis}', "
+                    f"which no mesh declares (declared: "
+                    f"{', '.join(sorted(declared))}); use the "
+                    f"parallel/mesh.py axis constants instead of a "
+                    f"string literal"
+                ),
+            ))
+            continue
+        if site.fn_key is None:
+            out.append(Violation(
+                rule="collective-axis-discipline",
+                path=site.rel,
+                line=site.lineno,
+                message=(
+                    f"collective `{site.op}` at module level — no mesh "
+                    f"axis is bound outside a shard-mapped program; move "
+                    f"it inside a function traced via "
+                    f"parallel/dispatch.py"
+                ),
+            ))
+        elif site.fn_key not in analysis.shard:
+            where = "never traced" if site.fn_key not in analysis.regions \
+                else ("traced via "
+                      f"{traced.short_origin(analysis.regions[site.fn_key])}"
+                      " but not shard-mapped")
+            out.append(Violation(
+                rule="collective-axis-discipline",
+                path=site.rel,
+                line=site.lineno,
+                message=(
+                    f"collective `{site.op}` in `{site.fn_name}` is "
+                    f"{where}: no axis '{site.axis or 'data'}' is bound "
+                    f"here, so the launch fails (or reduces over the "
+                    f"wrong group) at run time; reach it through "
+                    f"shard_map_compat/data_parallel or drop the "
+                    f"collective"
+                ),
+            ))
+    return out

@@ -1,0 +1,93 @@
+"""untracked-compile-input: the PR-9 `kernelBlockRows` bug class as a
+lint.
+
+A `conf.get*` (or rebindable module-global) read inside a traced region
+executes ONCE, at trace time, and the value is burned into the compiled
+executable. If that value does not also ride the program cache key (the
+`key = (...)` tuples in the `ml/` getters) and the prewarm-manifest
+signature (`parallel/prewarm.py`), then changing the knob at run time
+silently keeps serving the stale executable — or worse, the prewarm
+replay compiles with one value and live traffic with another. PR-9
+found exactly this by hand review (`kernelBlockRows` read during trace,
+missing from the tree program cache keys); this rule machine-checks it,
+in two legs over the `lint/traced.py` compile-input model:
+
+* **trace-time read**: any conf/global read whose innermost enclosing
+  function is inside a traced region. The sanctioned pattern is always
+  available: resolve the knob in the host-side getter, close over the
+  value, and put it in the key tuple — so every such read is flagged,
+  with a note when the key is already tracked by some cache key
+  elsewhere (the read can still diverge from the keyed value).
+* **key gap**: a conf key that flows into a cached program build (via
+  an argument expression or a resolver closure) inside a getter that
+  owns a `key = (...)` tuple, but is carried by no name riding the key
+  and by no prewarm signature field.
+
+`self.<attr>` reads in traced regions are modeled by the analysis but
+deliberately generate no findings (see traced.py's limits)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import traced
+from ..core import Violation, rule
+from ..project import Project
+
+
+@rule(
+    "untracked-compile-input",
+    "Conf/global reads must not trace into device programs off-key",
+)
+def check(project: Project) -> List[Violation]:
+    analysis = traced.analyze(project)
+    out: List[Violation] = []
+    for read in analysis.conf_reads:
+        if read.fn_key is None or read.fn_key not in analysis.regions:
+            continue
+        origin = traced.short_origin(analysis.regions[read.fn_key])
+        tracked = (" (the key rides a cache key elsewhere, but this "
+                   "trace-time read can diverge from the keyed value)"
+                   if read.key in analysis.tracked_keys else "")
+        out.append(Violation(
+            rule="untracked-compile-input",
+            path=read.rel,
+            line=read.lineno,
+            message=(
+                f"conf read `{read.key}` inside traced region "
+                f"({origin}) executes at trace time and is burned into "
+                f"the executable{tracked}; resolve it in the host-side "
+                f"getter and pass the value in (riding the program "
+                f"cache key)"
+            ),
+        ))
+    for read in analysis.global_reads:
+        if read.fn_key not in analysis.regions:
+            continue
+        origin = traced.short_origin(analysis.regions[read.fn_key])
+        out.append(Violation(
+            rule="untracked-compile-input",
+            path=read.rel,
+            line=read.lineno,
+            message=(
+                f"module global `{read.name}` (rebound via `global` "
+                f"elsewhere) read inside traced region ({origin}): the "
+                f"trace-time snapshot never refreshes; pass the value "
+                f"as an argument or close over it in the getter"
+            ),
+        ))
+    for gap in analysis.key_gaps:
+        carrier = f" via `{gap.carrier}`" if gap.carrier else ""
+        out.append(Violation(
+            rule="untracked-compile-input",
+            path=gap.rel,
+            line=gap.lineno,
+            message=(
+                f"conf key `{gap.conf_key}` flows into the program "
+                f"built by `{gap.getter}`{carrier} but rides neither "
+                f"this cache key tuple nor the prewarm signature: "
+                f"changing the knob keeps serving the stale executable; "
+                f"add the resolved value to the key"
+            ),
+        ))
+    return out

@@ -1,0 +1,57 @@
+"""divergent-collective: a collective under a host-divergent branch is
+the multi-host deadlock shape.
+
+Collectives are rendezvous points — EVERY chip in the axis group must
+execute the same launch sequence. A Python `if` inside a traced region
+evaluates at trace time on each process independently; when its test
+depends on a host-local value (a conf read, `os.environ`, process
+index/count) or on data shape, two hosts can trace DIFFERENT programs:
+one with the psum, one without. On a single host that is a silent
+numerics skew; over DCN it is a hang (the chips that launched the
+collective block forever on the ones that didn't — the coordination
+failure mode the multi-host ROADMAP item inherits).
+
+This rule reads the branch-context model from `lint/traced.py`: a
+collective site inside a traced region whose enclosing `if`/`while`/
+ternary test is tainted by a host value or data-dependent shape. Config
+branches that select BETWEEN whole programs on the host side (the
+getter pattern: resolve conf, then build) are fine and not flagged —
+the getter is not a traced region."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import traced
+from ..core import Violation, rule
+from ..project import Project
+
+
+@rule(
+    "divergent-collective",
+    "No collectives under host-value- or data-dependent branches in "
+    "traced code",
+)
+def check(project: Project) -> List[Violation]:
+    analysis = traced.analyze(project)
+    out: List[Violation] = []
+    for site in analysis.collectives:
+        if site.divergent is None or site.fn_key is None:
+            continue
+        if site.fn_key not in analysis.regions:
+            continue
+        if site.fn_name in traced.COLLECTIVE_OPS:
+            continue
+        out.append(Violation(
+            rule="divergent-collective",
+            path=site.rel,
+            line=site.lineno,
+            message=(
+                f"collective `{site.op}` in traced `{site.fn_name}` is "
+                f"guarded by a branch on {site.divergent}: hosts can "
+                f"trace different programs and deadlock at the "
+                f"rendezvous; hoist the branch to the host-side getter "
+                f"or make both arms launch the collective"
+            ),
+        ))
+    return out

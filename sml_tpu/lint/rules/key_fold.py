@@ -1,0 +1,47 @@
+"""per-chip-key-fold: per-device randomness must come from the
+replicated-key slice, not `fold_in(key, axis_index())`.
+
+The PR-6 contract (`tree_impl._sliced_draw`) makes distributed sampling
+layout-independent: every chip draws from ONE replicated key and
+`dynamic_slice`s its own rows, so an N-chip fit and a 1-chip fit
+consume identical random streams and produce identical models. Folding
+a device or process index into the key (`jax.random.fold_in(key,
+coll.axis_index())`) breaks that — the stream depends on how many
+chips the mesh happens to have, so fits stop being reproducible across
+topologies and the N-chip == 1-chip parity tests go flaky.
+
+This rule reads the fold-site model from `lint/traced.py`: any
+`fold_in(...)` call whose folded operand is (or is assigned from) a
+device/process-index call, anywhere in the linted tree. Folding loop
+counters, round numbers, or column ids stays fine."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import traced
+from ..core import Violation, rule
+from ..project import Project
+
+
+@rule(
+    "per-chip-key-fold",
+    "No fold_in-by-device-index randomness; use the replicated-key slice",
+)
+def check(project: Project) -> List[Violation]:
+    analysis = traced.analyze(project)
+    out: List[Violation] = []
+    for site in analysis.fold_sites:
+        out.append(Violation(
+            rule="per-chip-key-fold",
+            path=site.rel,
+            line=site.lineno,
+            message=(
+                f"`fold_in` folds {site.detail} into a PRNG key: the "
+                f"random stream becomes mesh-layout-dependent and "
+                f"N-chip fits stop matching 1-chip fits; draw from the "
+                f"replicated key and take this chip's rows with a "
+                f"dynamic slice (the `_sliced_draw` pattern)"
+            ),
+        ))
+    return out

@@ -1,0 +1,998 @@
+"""Traced-region inference and distributed-semantics models — the
+dataflow core under the distributed rules (`collective-axis-discipline`,
+`divergent-collective`, `untracked-compile-input`, `per-chip-key-fold`).
+
+The engine's device programs are ordinary Python functions until a
+compile wrapper traces them: `jax.jit` / `pjit` / `pmap`, a
+`pallas_call` kernel launch, `shard_map`, or the sanctioned
+`parallel/dispatch.py`-governed helpers (`data_parallel`,
+`cached_data_parallel`, `run_data_parallel`, `shard_map_compat`). Code
+inside a traced region runs under different semantics than host code:
+Python-level reads happen ONCE at trace time (a `conf.get` there is
+burned into the executable and silently diverges from the program cache
+key — the PR-9 `kernelBlockRows` bug class), collectives must name axes
+the active mesh declares and must execute on EVERY chip (a
+host-dependent branch around a `psum` is the multi-host deadlock
+shape), and per-chip randomness must come from the sanctioned PR-6
+replicated-key slice (`tree_impl._sliced_draw`), never a
+`fold_in(key, axis_index())`. This module rebuilds those region
+boundaries statically:
+
+1. **Traced-region map** (`regions` / `shard`): seeds are the first
+   callable argument at every compile-wrapper call site (the same
+   `_is_jax_jit_expr` predicate — and the same ALLOWLIST — the
+   `dispatch-bypass` rule uses, so the region map and the bypass rule
+   can never disagree about what is a compile site), at every
+   tracer-wrapper call (`shard_map_compat`, `data_parallel`, …), and
+   every `@jax.jit`-style decorated def. A seed argument resolves
+   through local assignments (`program = _make_chunk_program(...)`
+   then `shard_map_compat(program, ...)`) and through FACTORY calls:
+   seeding `factory(...)` marks the factory's NESTED defs as traced
+   (the returned closure), never the factory's own host-side body.
+   Regions propagate over the project call graph with the same
+   form-aware resolution `lint/threads.py` uses, plus closure edges
+   (`builder = _make_tree_builder(...)` then `builder(x)` reaches the
+   factory's nested defs). `shard` is the subset reachable from a
+   shard-mapping seed — only there do collectives have an axis to run
+   on; a seed discovered lexically inside an already-shard-mapped
+   region inherits shardedness (the `jax.vmap(program)`-inside-
+   `shard_map` composition).
+
+2. **Mesh/axis model** (`declared_axes` / `axis_constants`): every
+   module-level `<NAME>_AXIS = "literal"` constant plus the axis-name
+   tuples passed to `Mesh(...)` / `build_mesh(axis_names=...)`. Each
+   `coll.psum` / `collectives.*` call site records its axis argument
+   resolved against these (literal string, axis-constant name or
+   attribute, or a local alias like `T = meshlib.TRIAL_AXIS`);
+   arguments that stay dynamic (a parameter) are recorded as such and
+   judged by no rule. Collective calls inside the wrapper definitions
+   themselves (`psum_scalars` composing `psum`) are exempt by
+   construction.
+
+3. **Compile-input model** (`conf_reads` / `global_reads` /
+   `self_reads` / `key_gaps` / `tracked_keys` / `prewarm_covered`):
+   every `conf.get*("sml.*")` read, every read of a module global that
+   some function rebinds via a `global` statement, and every
+   `self.<attr>` read, attributed to its innermost function. Program
+   cache keys (tuple assignments to `*key*` names in a function that
+   also calls a compile/tracer wrapper — the `ml/tree_impl.py` /
+   `ml/inference.py` getter shape) are joined against the conf keys
+   that FLOW into the program build: a resolver result carried by a
+   local name (`brows = _kernel_block_rows(kernel)`) is covered when
+   that name rides the key tuple; a conf key riding no key element and
+   no prewarm-manifest signature field (`parallel/prewarm.py`
+   `record(...)` dicts and `fn._prewarm` tags) is a `key_gaps` entry.
+
+Deliberate limits (kept so the pass stays fast and low-noise):
+region propagation stops at the HOST_BOUNDARY modules (`obs/`,
+`parallel/mesh.py`, `conf.py`) — observability calls inside a traced
+function are trace-time side effects whose results never enter the
+program, and mesh bookkeeping is keyed by `id(mesh)` in every cache
+key; lambdas handed to compile wrappers are not seeds; axis names that
+reach a collective only through function parameters are not checked;
+dict-shaped program caches keyed by non-`key`-named variables are
+invisible to the cache-key join; `self.<attr>` reads inside traced
+regions are modeled but generate no findings (bound-method programs
+are rare and the noise would drown the conf leg); and host-divergence
+taint is one assignment level deep. Everything here is stdlib-`ast`
+only and jax-free, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .project import FunctionInfo, Project, call_target_name
+from .rules.dispatch_bypass import ALLOWLIST, _is_jax_jit_expr
+
+#: the `parallel/collectives.py` wrapper surface (and the raw lax names
+#: they forward to) — any call through one of these simple names is a
+#: collective launch every chip in the mesh must execute together
+COLLECTIVE_OPS = frozenset({
+    "psum", "psum_scalars", "pmean", "pmax", "pmin", "all_gather",
+    "reduce_scatter", "psum_scatter", "all_to_all", "ppermute",
+    "axis_index", "masked_count",
+})
+
+#: callee simple name -> does it SHARD-map its first argument?
+#: (vmap traces but adds no mesh axis; jit/pallas seeds are handled by
+#: `_is_jax_jit_expr` and carry their own shard flags)
+TRACER_WRAPPERS: Dict[str, bool] = {
+    "shard_map": True,
+    "shard_map_compat": True,
+    "data_parallel": True,
+    "cached_data_parallel": True,
+    "run_data_parallel": True,
+    "vmap": False,
+}
+
+#: structured-control-flow tracers: callee simple name -> positional
+#: indices of the function arguments they trace (`lax.scan(body, …)`,
+#: `fori_loop(lo, hi, body, init)`, `cond(pred, true_fn, false_fn)`).
+#: They add no mesh axis of their own; shardedness comes from the
+#: enclosing region (site elevation in `_propagate`).
+CONTROL_FLOW_TRACERS: Dict[str, Tuple[int, ...]] = {
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "switch": (1,),
+}
+
+#: host-infrastructure boundary: region propagation (and resolver conf
+#: closures) never follow call edges INTO these — observability calls
+#: inside a traced function are trace-time side effects whose results
+#: never become traced values, and mesh bookkeeping is keyed by
+#: `id(mesh)` in every program cache key
+HOST_BOUNDARY = ("sml_tpu/obs/", "sml_tpu/parallel/mesh.py",
+                 "sml_tpu/conf.py")
+
+#: conf accessor method names: `<obj>.get*("sml.…")` is a conf read
+CONF_GETTERS = frozenset({"get", "getInt", "getBool", "getFloat"})
+
+#: calls whose result names THIS chip/host — folding one into a PRNG
+#: key makes randomness layout-dependent (N-chip != 1-chip fits)
+DEVICE_INDEX_CALLS = frozenset({
+    "axis_index", "process_index", "local_device_index", "device_index",
+})
+
+#: calls whose result is a host-local value that can DIFFER across the
+#: processes of a multi-host program — branching a collective on one
+#: lets chips disagree about whether the launch happens
+HOST_VALUE_CALLS = frozenset({
+    "getenv", "gethostname", "process_index", "process_count",
+    "host_count", "host_id", "device_count", "local_device_count",
+})
+
+
+class CollectiveSite:
+    """One collective call inside the linted tree."""
+
+    __slots__ = ("rel", "lineno", "op", "axis", "axis_kind", "fn_key",
+                 "fn_name", "divergent")
+
+    def __init__(self, rel: str, lineno: int, op: str, axis: Optional[str],
+                 axis_kind: str, fn_key: Optional[str],
+                 fn_name: Optional[str], divergent: Optional[str]):
+        self.rel = rel
+        self.lineno = lineno
+        self.op = op
+        self.axis = axis            # resolved axis literal, or None
+        self.axis_kind = axis_kind  # "literal" | "default" | "dynamic"
+        self.fn_key = fn_key        # enclosing "rel::qualname" (None=module)
+        self.fn_name = fn_name      # enclosing simple name
+        self.divergent = divergent  # taint reason when branch-guarded
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"<coll {self.op}({self.axis_kind}:{self.axis}) @ "
+                f"{self.rel}:{self.lineno} in {self.fn_key}>")
+
+
+class ConfRead:
+    """One `conf.get*("sml.…")` call, innermost-function attributed."""
+
+    __slots__ = ("rel", "lineno", "key", "fn_key")
+
+    def __init__(self, rel: str, lineno: int, key: str,
+                 fn_key: Optional[str]):
+        self.rel = rel
+        self.lineno = lineno
+        self.key = key
+        self.fn_key = fn_key
+
+
+class GlobalRead:
+    """A read of a module global some function rebinds via `global`."""
+
+    __slots__ = ("rel", "lineno", "name", "fn_key")
+
+    def __init__(self, rel: str, lineno: int, name: str, fn_key: str):
+        self.rel = rel
+        self.lineno = lineno
+        self.name = name
+        self.fn_key = fn_key
+
+
+class SelfRead:
+    """A `self.<attr>` load (modeled only; no rule leg — see limits)."""
+
+    __slots__ = ("rel", "lineno", "attr", "fn_key")
+
+    def __init__(self, rel: str, lineno: int, attr: str, fn_key: str):
+        self.rel = rel
+        self.lineno = lineno
+        self.attr = attr
+        self.fn_key = fn_key
+
+
+class FoldSite:
+    """A `fold_in(...)` whose folded value names this chip/host."""
+
+    __slots__ = ("rel", "lineno", "detail", "fn_key")
+
+    def __init__(self, rel: str, lineno: int, detail: str,
+                 fn_key: Optional[str]):
+        self.rel = rel
+        self.lineno = lineno
+        self.detail = detail
+        self.fn_key = fn_key
+
+
+class KeyGap:
+    """A conf key that flows into a cached program build but rides
+    neither the cache key tuple nor the prewarm signature."""
+
+    __slots__ = ("rel", "lineno", "conf_key", "getter", "carrier")
+
+    def __init__(self, rel: str, lineno: int, conf_key: str, getter: str,
+                 carrier: Optional[str]):
+        self.rel = rel
+        self.lineno = lineno        # the key-tuple assignment to fix
+        self.conf_key = conf_key
+        self.getter = getter
+        self.carrier = carrier      # local name carrying the value, if any
+
+
+class TracedAnalysis:
+    def __init__(self) -> None:
+        #: "rel::qualname" -> origin label ("<kind>:<rel>::<qual>@<line>",
+        #: prefixed "sanctioned-" when the seed site is dispatch-bypass
+        #: allowlisted)
+        self.regions: Dict[str, str] = {}
+        #: subset of regions reachable from a shard-mapping seed
+        self.shard: Set[str] = set()
+        self.declared_axes: Set[str] = set()
+        #: axis-constant name -> literal (merged project-wide)
+        self.axis_constants: Dict[str, str] = {}
+        self.collectives: List[CollectiveSite] = []
+        self.conf_reads: List[ConfRead] = []
+        self.global_reads: List[GlobalRead] = []
+        self.self_reads: List[SelfRead] = []
+        self.fold_sites: List[FoldSite] = []
+        #: conf keys covered by some program cache key or prewarm field
+        self.tracked_keys: Set[str] = set()
+        #: conf keys riding prewarm record(...)/._prewarm signature dicts
+        self.prewarm_covered: Set[str] = set()
+        self.key_gaps: List[KeyGap] = []
+
+
+def analyze(project: Project) -> TracedAnalysis:
+    """Memoized on the project (all four rules share one pass)."""
+    cached = getattr(project, "_traced_analysis", None)
+    if cached is not None:
+        return cached
+    out = _Analyzer(project).run()
+    project._traced_analysis = out
+    return out
+
+
+def traced_regions(project: Project) -> Dict[str, str]:
+    """"rel::qualname" -> origin, for every traced function."""
+    return analyze(project).regions
+
+
+def _fn_key(fn: FunctionInfo) -> str:
+    return f"{fn.rel}::{fn.qualname}"
+
+
+def short_origin(origin: str) -> str:
+    """Violation-message form of a region origin:
+    "shard_map:ml/x.py::_compiled@12" -> "shard_map@_compiled". The
+    label format is defined here — rules must not re-derive it."""
+    kind = origin.split(":", 1)[0]
+    tail = origin.split("::", 1)[-1].split("@", 1)[0]
+    return f"{kind}@{tail.rsplit('.', 1)[-1] or '<module>'}"
+
+
+def _allowlisted(rel: str, qualname: str) -> bool:
+    """The dispatch-bypass ALLOWLIST judgment, reused verbatim: is this
+    (file, enclosing function) a blessed compile owner?"""
+    allow = ALLOWLIST.get(rel, {})
+    if not allow:
+        for pref, entry in ALLOWLIST.items():
+            if pref.endswith("/") and rel.startswith(pref):
+                allow = entry
+                break
+    if "*" in allow:
+        return True
+    return qualname in allow or qualname.rsplit(".", 1)[-1] in allow
+
+
+class _Seed:
+    __slots__ = ("targets", "shard", "origin", "site_key")
+
+    def __init__(self, targets: List[FunctionInfo], shard: bool,
+                 origin: str, site_key: Optional[str]):
+        self.targets = targets
+        self.shard = shard
+        self.origin = origin
+        self.site_key = site_key
+
+
+class _Analyzer:
+    def __init__(self, project: Project):
+        self.project = project
+        self.index = project.function_index()
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        for fns in self.index.values():
+            for fn in fns:
+                self.by_name.setdefault(fn.name, []).append(fn)
+        #: (rel, scope qualname or "") -> {name: value expr} from simple
+        #: single-target assignments, innermost-scope attributed
+        self.assigns: Dict[Tuple[str, str], Dict[str, ast.expr]] = {}
+        #: rel -> names rebound via a `global` statement somewhere
+        self.global_names: Dict[str, Set[str]] = {}
+        #: per-function direct conf reads (for closures)
+        self._direct_conf: Dict[str, Set[str]] = {}
+        self._closure_memo: Dict[str, Set[str]] = {}
+        self.out = TracedAnalysis()
+
+    # ------------------------------------------------------------- helpers
+    def _local(self, rel: str) -> Dict[str, FunctionInfo]:
+        return {fn.name: fn for fn in self.index.get(rel, [])}
+
+    def _resolve_def(self, rel: str, name: str) -> Optional[FunctionInfo]:
+        """Simple-name function resolution: same module first, then
+        cross-module only when exactly one project function bears it."""
+        local = self._local(rel)
+        if name in local:
+            return local[name]
+        cands = self.by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _scope_lookup(self, rel: str, scope: str,
+                      name: str) -> Optional[ast.expr]:
+        """Walk the lexical scope chain ("a.b.c" -> "a.b" -> "a" -> "")
+        for the value expression last assigned to `name`."""
+        parts = scope.split(".") if scope else []
+        while True:
+            got = self.assigns.get((rel, ".".join(parts)), {}).get(name)
+            if got is not None:
+                return got
+            if not parts:
+                return None
+            parts.pop()
+
+    def _nested_defs(self, factory: FunctionInfo) -> List[FunctionInfo]:
+        pref = factory.qualname + "."
+        return [fn for fn in self.index.get(factory.rel, [])
+                if fn.qualname.startswith(pref)]
+
+    def _conf_key_of(self, call: ast.Call) -> Optional[str]:
+        """The "sml.*"/"spark.*" key when `call` is a conf read."""
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in CONF_GETTERS and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+                and call.args[0].value.startswith(("sml.", "spark."))):
+            return call.args[0].value
+        return None
+
+    # ------------------------------------------------------ pass 1: tables
+    def _collect_tables(self) -> None:
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            # module-level axis constants
+            for node in f.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    name = node.targets[0].id
+                    if name.isupper() and "AXIS" in name:
+                        self.out.axis_constants[name] = node.value.value
+                        self.out.declared_axes.add(node.value.value)
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets \
+                        if isinstance(node, ast.Assign) else [node.target]
+                    value = node.value
+                    if value is None or len(targets) != 1 \
+                            or not isinstance(targets[0], ast.Name):
+                        continue
+                    encl = self.project.enclosing_function(f.rel,
+                                                           node.lineno)
+                    scope = encl.qualname if encl is not None else ""
+                    self.assigns.setdefault((f.rel, scope), {})[
+                        targets[0].id] = value
+                elif isinstance(node, ast.Global):
+                    self.global_names.setdefault(f.rel, set()).update(
+                        node.names)
+                elif isinstance(node, ast.Call):
+                    # mesh constructions declare axes
+                    name = call_target_name(node.func)
+                    exprs: List[ast.expr] = []
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            exprs.append(kw.value)
+                    if name == "Mesh":
+                        exprs.extend(node.args)
+                    for e in exprs:
+                        for sub in ast.walk(e):
+                            if isinstance(sub, ast.Constant) \
+                                    and isinstance(sub.value, str):
+                                self.out.declared_axes.add(sub.value)
+        # direct conf reads, innermost attributed (linted files only)
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = self._conf_key_of(node)
+                if key is None:
+                    continue
+                encl = self.project.enclosing_function(f.rel, node.lineno)
+                fn_key = _fn_key(encl) if encl is not None else None
+                self.out.conf_reads.append(
+                    ConfRead(f.rel, node.lineno, key, fn_key))
+                if fn_key is not None:
+                    self._direct_conf.setdefault(fn_key, set()).add(key)
+
+    # ----------------------------------------------------- conf closures
+    def _conf_closure(self, fn: FunctionInfo,
+                      _stack: Optional[Set[str]] = None) -> Set[str]:
+        """Conf keys read by `fn` or any function it (resolvably) calls.
+        Nested defs are separate functions — a factory's closure covers
+        its own host-side body, not the program it returns."""
+        key = _fn_key(fn)
+        memo = self._closure_memo.get(key)
+        if memo is not None:
+            return memo
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return set()
+        stack.add(key)
+        out = set(self._direct_conf.get(key, ()))
+        for callee in self._callees(fn):
+            out |= self._conf_closure(callee, stack)
+        stack.discard(key)
+        self._closure_memo[key] = out
+        return out
+
+    def _callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Form-aware call-graph edges (the lint/threads.py resolution:
+        `self.m()` binds only within the class, `obj.m()` only when the
+        name is project-unique, bare `f()` prefers same-module defs),
+        plus closure edges: a called name assigned from a factory call
+        reaches the factory's nested defs. Edges into HOST_BOUNDARY
+        modules are dropped — see the constant's note."""
+        local = self._local(fn.rel)
+        own_cls = fn.qualname.rsplit(".", 1)[0] \
+            if "." in fn.qualname else None
+        out: List[FunctionInfo] = []
+        forms = fn.call_forms or [("name", n) for n in fn.calls]
+        for form, name in forms:
+            if form == "self":
+                if own_cls is not None:
+                    for cand in self.index.get(fn.rel, []):
+                        if cand.qualname == f"{own_cls}.{name}":
+                            out.append(cand)
+                            break
+                continue
+            if form == "name":
+                if name in local:
+                    out.append(local[name])
+                    continue
+                expr = self._scope_lookup(fn.rel, fn.qualname, name)
+                if isinstance(expr, ast.Call):
+                    factory = self._resolve_def(
+                        fn.rel, call_target_name(expr.func) or "")
+                    if factory is not None:
+                        out.extend(self._nested_defs(factory))
+                        continue
+            cands = self.by_name.get(name, [])
+            if len(cands) == 1:
+                out.append(cands[0])
+        if fn.rel.startswith(HOST_BOUNDARY):
+            return out
+        return [c for c in out if not c.rel.startswith(HOST_BOUNDARY)]
+
+    # ------------------------------------------------------ pass 2: seeds
+    def _seed_targets(self, expr: ast.expr, rel: str, scope: str,
+                      depth: int = 0) -> List[FunctionInfo]:
+        """The functions a compile-wrapper argument traces: a named def,
+        a name assigned from a factory call (the factory's NESTED defs),
+        or a direct factory call."""
+        if depth > 6:
+            return []
+        if isinstance(expr, ast.Name):
+            # the lexically-local binding (e.g. `round_fn =
+            # make_round(...)`) shadows any same-named def elsewhere
+            assigned = self._scope_lookup(rel, scope, expr.id)
+            if assigned is not None and not isinstance(assigned, ast.Name):
+                got = self._seed_targets(assigned, rel, scope, depth + 1)
+                if got:
+                    return got
+            fn = self._resolve_def(rel, expr.id)
+            if fn is not None:
+                return [fn]
+            return []
+        if isinstance(expr, ast.Call):
+            name = call_target_name(expr.func)
+            if name in TRACER_WRAPPERS or name == "partial" \
+                    or _is_jax_jit_expr(expr.func):
+                if expr.args:
+                    return self._seed_targets(expr.args[0], rel, scope,
+                                              depth + 1)
+                return []
+            factory = self._resolve_def(rel, name or "")
+            if factory is not None:
+                return self._nested_defs(factory)
+        return []
+
+    def _collect_seeds(self) -> List[_Seed]:
+        seeds: List[_Seed] = []
+
+        def site(rel: str, lineno: int) -> Tuple[Optional[str], str]:
+            encl = self.project.enclosing_function(rel, lineno)
+            if encl is None:
+                return None, "<module>"
+            return _fn_key(encl), encl.qualname
+
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        kind = self._compile_kind(dec)
+                        if kind is None:
+                            continue
+                        encl = self.project.enclosing_function(f.rel,
+                                                               node.lineno)
+                        if encl is None:
+                            continue
+                        seeds.append(self._make_seed(
+                            [encl], kind, f.rel, node.lineno,
+                            encl.qualname, None))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._compile_kind(node.func)
+                wrap = call_target_name(node.func)
+                if kind is None and wrap in TRACER_WRAPPERS:
+                    kind = wrap
+                if kind is None and wrap == "partial" and node.args \
+                        and _is_jax_jit_expr(node.args[0]):
+                    # partial(jax.jit, fn, ...) as a call expression
+                    if len(node.args) > 1:
+                        fn_key, qual = site(f.rel, node.lineno)
+                        targets = self._seed_targets(
+                            node.args[1], f.rel,
+                            qual if qual != "<module>" else "")
+                        seeds.append(self._make_seed(
+                            targets, "jit", f.rel, node.lineno, qual,
+                            fn_key))
+                    continue
+                if kind is None and wrap in CONTROL_FLOW_TRACERS:
+                    fn_key, qual = site(f.rel, node.lineno)
+                    for pos in CONTROL_FLOW_TRACERS[wrap]:
+                        if pos >= len(node.args):
+                            continue
+                        targets = self._seed_targets(
+                            node.args[pos], f.rel,
+                            qual if qual != "<module>" else "")
+                        seeds.append(self._make_seed(
+                            targets, wrap, f.rel, node.lineno, qual,
+                            fn_key))
+                    continue
+                if kind is None or not node.args:
+                    continue
+                fn_key, qual = site(f.rel, node.lineno)
+                targets = self._seed_targets(
+                    node.args[0], f.rel,
+                    qual if qual != "<module>" else "")
+                seeds.append(self._make_seed(targets, kind, f.rel,
+                                             node.lineno, qual, fn_key))
+        return [s for s in seeds if s.targets]
+
+    def _compile_kind(self, func: ast.expr) -> Optional[str]:
+        """"jit"/"pmap"/"pallas" when `func` is a compile constructor
+        (the dispatch-bypass predicate), else None."""
+        if not _is_jax_jit_expr(func):
+            return None
+        name = func.attr if isinstance(func, ast.Attribute) else func.id
+        if name == "pallas_call":
+            return "pallas"
+        return name
+
+    def _make_seed(self, targets: List[FunctionInfo], kind: str, rel: str,
+                   lineno: int, qual: str,
+                   site_key: Optional[str]) -> _Seed:
+        shard = kind == "pmap" or bool(TRACER_WRAPPERS.get(kind))
+        sanction = "sanctioned-" if _allowlisted(rel, qual) else ""
+        origin = f"{sanction}{kind}:{rel}::{qual}@{lineno}"
+        return _Seed(targets, shard, origin, site_key)
+
+    # ------------------------------------------------ pass 3: propagation
+    def _propagate(self, seeds: List[_Seed]) -> None:
+        regions, shard = self.out.regions, self.out.shard
+
+        def mark(fn: FunctionInfo, is_shard: bool, origin: str) -> None:
+            work = [(fn, is_shard)]
+            while work:
+                cur, sh = work.pop()
+                key = _fn_key(cur)
+                known = key in regions
+                if known and (not sh or key in shard):
+                    continue
+                if not known:
+                    regions[key] = origin
+                if sh:
+                    shard.add(key)
+                for callee in self._callees(cur):
+                    work.append((callee, sh))
+
+        changed = True
+        while changed:
+            changed = False
+            for seed in seeds:
+                sh = seed.shard or (seed.site_key is not None
+                                    and seed.site_key in shard)
+                for fn in seed.targets:
+                    key = _fn_key(fn)
+                    if key not in regions or (sh and key not in shard):
+                        mark(fn, sh, seed.origin)
+                        changed = True
+
+    # -------------------------------------------- pass 4: per-site models
+    def _axis_of(self, expr: ast.expr, rel: str, scope: str,
+                 depth: int = 0) -> Optional[str]:
+        """Resolve an expression to an axis-name literal, or None."""
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            if expr.id in self.out.axis_constants:
+                return self.out.axis_constants[expr.id]
+            assigned = self._scope_lookup(rel, scope, expr.id)
+            if assigned is not None:
+                return self._axis_of(assigned, rel, scope, depth + 1)
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and expr.attr in self.out.axis_constants:
+            return self.out.axis_constants[expr.attr]
+        return None
+
+    def _site_axis(self, call: ast.Call, rel: str,
+                   scope: str) -> Tuple[Optional[str], str]:
+        """(axis literal or None, kind): keyword axis=/axis_name= wins;
+        otherwise the unique axis-resolvable positional argument."""
+        for kw in call.keywords:
+            if kw.arg in ("axis", "axis_name"):
+                axis = self._axis_of(kw.value, rel, scope)
+                return (axis, "literal") if axis is not None \
+                    else (None, "dynamic")
+        cands = [self._axis_of(a, rel, scope) for a in call.args]
+        hits = [a for a in cands if a is not None]
+        if len(hits) == 1:
+            return hits[0], "literal"
+        if not hits:
+            return None, "default"
+        return None, "dynamic"
+
+    def _fold_detail(self, call: ast.Call, rel: str,
+                     scope: str) -> Optional[str]:
+        """Why this fold_in is per-chip, or None when it is not."""
+        for arg in call.args + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call):
+                    name = call_target_name(sub.func)
+                    if name in DEVICE_INDEX_CALLS:
+                        return f"`{name}()`"
+                elif isinstance(sub, ast.Name):
+                    assigned = self._scope_lookup(rel, scope, sub.id)
+                    if isinstance(assigned, ast.Call):
+                        name = call_target_name(assigned.func)
+                        if name in DEVICE_INDEX_CALLS:
+                            return f"`{sub.id}` (= `{name}()`)"
+        return None
+
+    def _taint_reason(self, expr: ast.expr, fn: FunctionInfo,
+                      tainted: Dict[str, str]) -> Optional[str]:
+        """Why a branch test is host-value- or data-dependent."""
+        params: Set[str] = set()
+        a = fn.node.args
+        for grp in (a.posonlyargs, a.args, a.kwonlyargs):
+            params.update(p.arg for p in grp)
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                if self._conf_key_of(sub) is not None:
+                    return f"conf read `{self._conf_key_of(sub)}`"
+                name = call_target_name(sub.func)
+                if name in HOST_VALUE_CALLS:
+                    return f"host call `{name}()`"
+                if name == "len" and sub.args \
+                        and isinstance(sub.args[0], ast.Name) \
+                        and sub.args[0].id in params:
+                    return f"data-dependent `len({sub.args[0].id})`"
+            elif isinstance(sub, ast.Attribute):
+                if sub.attr == "environ":
+                    return "`os.environ`"
+                if sub.attr == "shape" and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in params:
+                    return f"data-dependent `{sub.value.id}.shape`"
+            elif isinstance(sub, ast.Name) and sub.id in tainted:
+                return f"`{sub.id}` ({tainted[sub.id]})"
+        return None
+
+    def _fn_taint(self, fn: FunctionInfo) -> Dict[str, str]:
+        """Local names carrying host-divergent values (one level deep,
+        two passes so later assignments see earlier taint)."""
+        tainted: Dict[str, str] = {}
+        scoped = self.assigns.get((fn.rel, fn.qualname), {})
+        for _ in range(2):
+            for name, expr in scoped.items():
+                if name in tainted:
+                    continue
+                reason = self._taint_reason(expr, fn, tainted)
+                if reason is not None:
+                    tainted[name] = reason
+        return tainted
+
+    def _walk_function(self, f, fn: Optional[FunctionInfo]) -> None:
+        """One pass over a function body (or module top level), skipping
+        nested defs (they get their own walk): collective sites with
+        branch context, fold_in sites, global/self reads."""
+        rel = f.rel
+        fn_key = _fn_key(fn) if fn is not None else None
+        fn_name = fn.name if fn is not None else None
+        scope = fn.qualname if fn is not None else ""
+        tainted = self._fn_taint(fn) if fn is not None else {}
+        gnames = self.global_names.get(rel, set())
+        tests: List[ast.expr] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                visit(node.test)
+                tests.append(node.test)
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                orelse = node.orelse if isinstance(node.orelse, list) \
+                    else [node.orelse]
+                for child in body + orelse:
+                    visit(child)
+                tests.pop()
+                return
+            if isinstance(node, ast.Call):
+                self._note_call(node, rel, fn, fn_key, fn_name, scope,
+                                tainted, tests)
+            elif isinstance(node, ast.Name) and fn_key is not None \
+                    and isinstance(node.ctx, ast.Load) and node.id in gnames:
+                self.out.global_reads.append(
+                    GlobalRead(rel, node.lineno, node.id, fn_key))
+            elif isinstance(node, ast.Attribute) and fn_key is not None \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                self.out.self_reads.append(
+                    SelfRead(rel, node.lineno, node.attr, fn_key))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        body = fn.node.body if fn is not None else [
+            n for n in f.tree.body
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef))]
+        for stmt in body:
+            visit(stmt)
+
+    def _note_call(self, node: ast.Call, rel: str,
+                   fn: Optional[FunctionInfo], fn_key: Optional[str],
+                   fn_name: Optional[str], scope: str,
+                   tainted: Dict[str, str],
+                   tests: List[ast.expr]) -> None:
+        name = call_target_name(node.func)
+        if name in COLLECTIVE_OPS:
+            axis, kind = self._site_axis(node, rel, scope)
+            divergent = None
+            if fn is not None:
+                for t in tests:
+                    divergent = self._taint_reason(t, fn, tainted)
+                    if divergent is not None:
+                        break
+            self.out.collectives.append(CollectiveSite(
+                rel, node.lineno, name, axis, kind, fn_key, fn_name,
+                divergent))
+        elif name == "fold_in":
+            detail = self._fold_detail(node, rel, scope)
+            if detail is not None:
+                self.out.fold_sites.append(
+                    FoldSite(rel, node.lineno, detail, fn_key))
+
+    def _collect_sites(self) -> None:
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            self._walk_function(f, None)
+            for fn in self.index.get(f.rel, []):
+                self._walk_function(f, fn)
+
+    # ----------------------------------------- pass 5: compile-input join
+    def _prewarm_coverage(self) -> None:
+        """Conf keys whose resolved values ride prewarm-manifest
+        signature fields: `record(kind, {...})` dict values and
+        `fn._prewarm = (family, {...})` tags."""
+        covered = self.out.prewarm_covered
+
+        def cover(expr: ast.expr, rel: str, scope: str,
+                  depth: int = 0) -> None:
+            if depth > 4:
+                return
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    key = self._conf_key_of(sub)
+                    if key is not None:
+                        covered.add(key)
+                        continue
+                    target = self._resolve_def(
+                        rel, call_target_name(sub.func) or "")
+                    if target is not None:
+                        covered.update(self._conf_closure(target))
+                elif isinstance(sub, ast.Name):
+                    assigned = self._scope_lookup(rel, scope, sub.id)
+                    if assigned is not None \
+                            and not isinstance(assigned, ast.Name):
+                        cover(assigned, rel, scope, depth + 1)
+
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                dicts: List[ast.Dict] = []
+                if isinstance(node, ast.Call) \
+                        and call_target_name(node.func) == "record" \
+                        and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Dict):
+                    dicts.append(node.args[1])
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and node.targets[0].attr == "_prewarm":
+                    dicts.extend(d for d in ast.walk(node.value)
+                                 if isinstance(d, ast.Dict))
+                if not dicts:
+                    continue
+                encl = self.project.enclosing_function(f.rel, node.lineno)
+                scope = encl.qualname if encl is not None else ""
+                for d in dicts:
+                    for v in d.values:
+                        if v is not None:
+                            cover(v, f.rel, scope)
+
+    def _key_join(self) -> None:
+        """Per getter (a function owning both a `*key*` tuple and a
+        compile/tracer call): conf keys flowing into the program build
+        vs. the names and resolver closures riding the key tuple."""
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            for fn in self.index.get(f.rel, []):
+                if _fn_key(fn) in self.out.regions:
+                    continue
+                self._key_join_fn(f.rel, fn)
+
+    def _getter_shape(self, rel: str, fn: FunctionInfo
+                      ) -> Tuple[List[Tuple[int, ast.Tuple]],
+                                 List[ast.Call]]:
+        key_assigns: List[Tuple[int, ast.Tuple]] = []
+        builds: List[ast.Call] = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn.node:
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and "key" in node.targets[0].id.lower() \
+                    and isinstance(node.value, ast.Tuple):
+                encl = self.project.enclosing_function(rel, node.lineno)
+                if encl is fn:
+                    key_assigns.append((node.lineno, node.value))
+            elif isinstance(node, ast.Call):
+                name = call_target_name(node.func)
+                if name in TRACER_WRAPPERS \
+                        or _is_jax_jit_expr(node.func):
+                    encl = self.project.enclosing_function(rel,
+                                                           node.lineno)
+                    if encl is fn:
+                        builds.append(node)
+        return key_assigns, builds
+
+    def _key_join_fn(self, rel: str, fn: FunctionInfo) -> None:
+        key_assigns, builds = self._getter_shape(rel, fn)
+        if not key_assigns or not builds:
+            return
+        scope = fn.qualname
+
+        #: conf key -> carrier local names it flows through (None = direct)
+        flows: Dict[str, Set[Optional[str]]] = {}
+
+        def flow(expr: ast.expr, carrier: Optional[str],
+                 depth: int = 0, seen: Optional[Set[str]] = None) -> None:
+            seen = seen if seen is not None else set()
+            if depth > 6:
+                return
+            if isinstance(expr, ast.Name):
+                if expr.id in seen:
+                    return
+                seen.add(expr.id)
+                assigned = self._scope_lookup(rel, scope, expr.id)
+                if assigned is not None:
+                    flow(assigned, expr.id, depth + 1, seen)
+                return
+            if isinstance(expr, ast.Call):
+                key = self._conf_key_of(expr)
+                if key is not None:
+                    flows.setdefault(key, set()).add(carrier)
+                    return
+                name = call_target_name(expr.func)
+                is_tracer = name in TRACER_WRAPPERS \
+                    or name == "partial" or _is_jax_jit_expr(expr.func)
+                if not is_tracer:
+                    target = self._resolve_def(rel, name or "")
+                    if target is not None:
+                        for ck in self._conf_closure(target):
+                            flows.setdefault(ck, set()).add(carrier)
+                for a in expr.args:
+                    flow(a, carrier, depth + 1, seen)
+                for kw in expr.keywords:
+                    flow(kw.value, carrier, depth + 1, seen)
+                return
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    flow(child, carrier, depth + 1, seen)
+
+        for call in builds:
+            flow(call, None)
+
+        key_names: Set[str] = set()
+        key_cks: Set[str] = set()
+        for _, tup in key_assigns:
+            for elt in tup.elts:
+                for sub in ast.walk(elt):
+                    if isinstance(sub, ast.Name):
+                        key_names.add(sub.id)
+                    elif isinstance(sub, ast.Call):
+                        ck = self._conf_key_of(sub)
+                        if ck is not None:
+                            key_cks.add(ck)
+                            continue
+                        target = self._resolve_def(
+                            rel, call_target_name(sub.func) or "")
+                        if target is not None:
+                            key_cks.update(self._conf_closure(target))
+
+        line = key_assigns[0][0]
+        for ck in sorted(flows):
+            carriers = flows[ck]
+            named = sorted(c for c in carriers if c is not None)
+            if set(named) & key_names:
+                self.out.tracked_keys.add(ck)
+                continue
+            if ck in key_cks or ck in self.out.prewarm_covered:
+                self.out.tracked_keys.add(ck)
+                continue
+            self.out.key_gaps.append(KeyGap(
+                rel, line, ck, fn.qualname,
+                named[0] if named else None))
+        self.out.tracked_keys.update(key_cks)
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> TracedAnalysis:
+        self._collect_tables()
+        self._propagate(self._collect_seeds())
+        self._collect_sites()
+        self._prewarm_coverage()
+        self._key_join()
+        return self.out

@@ -251,9 +251,12 @@ def _forest_margin_path(binned_b, sf, sb, lv, weights, depth: int,
         from ..native import traverse_kernel as _tk
         from .tree_impl import _mesh_platform
         interp = _mesh_platform() != "tpu"
+        # block_rows is the HOST-resolved spec value riding this
+        # program's cache key; the kernel never reads conf at trace
+        # time (0 means one full block)
         return _tk.forest_traverse(binned_b, sf, sb, lv, weights,
                                    depth=depth, interpret=interp,
-                                   block_rows=block_rows or None)
+                                   block_rows=block_rows)
     return _forest_margin(binned_b, sf, sb, lv, weights, depth)
 
 

@@ -472,11 +472,14 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
             if use_pallas:
                 # fused bin-accumulate straight from the compact bin
                 # cache operand: the one-hot tiles live only in VMEM
+                # block_rows is the HOST-resolved value carried by this
+                # program's cache key; the kernel never reads conf at
+                # trace time (0 means one full block)
                 part = _hk.hist_accumulate(
                     binned if binned_c is None else binned_c,
                     lid_h, grad, hess, w_eff, n_bins=B, n_slots=hw,
                     hist_dtype=hist_dtype, interpret=interp,
-                    block_rows=block_rows or None)
+                    block_rows=block_rows)
             else:
                 node1hot = jax.nn.one_hot(lid_h, hw, dtype=hist_dtype) \
                     * (w_eff > 0)[:, None].astype(hist_dtype)

@@ -90,14 +90,18 @@ def _block_plan(n: int, interpret: bool,
     Interpret mode always uses ONE block: the whole per-chip row set goes
     through a single dot with the XLA path's exact dimension numbers —
     the bit-parity contract tier-1 asserts. Compiled mode picks the
-    largest divisor of `n` at or under `sml.tree.kernelBlockRows` so
-    every grid step sees a full block (no partial-block masking; rows
-    are already bucket-padded by staging, so divisors are dense)."""
-    if interpret:
+    largest divisor of `n` at or under `block_rows` so every grid step
+    sees a full block (no partial-block masking; rows are already
+    bucket-padded by staging, so divisors are dense).
+
+    `block_rows` is resolved HOST-side (`tree_impl._kernel_block_rows`
+    reads `sml.tree.kernelBlockRows` once per program build, and the
+    value rides every tree program cache key and the prewarm manifest);
+    this function runs at TRACE time and must never consult live conf —
+    a read here would be burned into the executable and silently diverge
+    from the keyed value. None/0 means no blocking: one full block."""
+    if interpret or not block_rows:
         return 1, n
-    if block_rows is None:
-        from ..conf import GLOBAL_CONF
-        block_rows = GLOBAL_CONF.getInt("sml.tree.kernelBlockRows")
     target = max(1, min(int(block_rows), n))
     k = -(-n // target)
     while n % k:

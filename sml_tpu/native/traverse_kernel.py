@@ -89,12 +89,16 @@ def _block_plan(n: int, interpret: bool,
     block — rows are bucket-padded by staging, so divisors are dense.
     Unlike the fit kernel's plan this never changes results: the
     traversal has no cross-row reduction, so blocking is pure VMEM
-    scheduling."""
-    if interpret:
+    scheduling.
+
+    `block_rows` is resolved HOST-side (`inference.resolve_infer_kernel`
+    reads `sml.infer.kernelBlockRows` once per program build, and the
+    value rides the inference program cache key); this function runs at
+    TRACE time and must never consult live conf — a read here would be
+    burned into the executable and silently diverge from the keyed
+    value. None/0 means no blocking: one full block."""
+    if interpret or not block_rows:
         return 1, n
-    if block_rows is None:
-        from ..conf import GLOBAL_CONF
-        block_rows = GLOBAL_CONF.getInt("sml.infer.kernelBlockRows")
     target = max(1, min(int(block_rows), n))
     k = -(-n // target)
     while n % k:

@@ -66,6 +66,10 @@ SERVE_TOL = 0.50
 STATIC_TOL = 0.01
 #: byte-volume counters (H2D, psum payload) below this are noise
 BYTES_FLOOR = 1 << 20
+#: absolute graftlint catalogue floor — the PR-18 distributed-semantics
+#: pass took the active rule count to 14; a candidate below it dropped
+#: an invariant rule even if its base record predates the pass
+LINT_RULE_FLOOR = 14
 
 #: per-leg engine counters the sentry judges, with their growth bound:
 #: ("count", slack) = cand may exceed base by max(1, slack*base);
@@ -656,6 +660,33 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                     "lint-rules", "rules", br, cr, 0.0, "regression",
                     "active graftlint rule count shrank — invariant "
                     "coverage loss"))
+    if cln:
+        # absolute floor, independent of the base record: the PR-18
+        # distributed-semantics pass took the catalogue to 14; any
+        # candidate below that lost rules even when diffed against a
+        # base that predates the pass
+        cr = float(cln.get("rules", 0))
+        checked += 1
+        if cr < LINT_RULE_FLOOR:
+            reg.append(_finding(
+                "lint-rule-floor", "rules", float(LINT_RULE_FLOOR), cr,
+                0.0, "regression",
+                f"active graftlint rule count below the {LINT_RULE_FLOOR}"
+                "-rule floor — a distributed-semantics rule was dropped"))
+        # exact-mode counter: untracked-compile-input caught a REAL
+        # silent-staleness bug class by hand twice (PR-9 review, PR-18
+        # fix) — one reappearance means a conf read traced into an
+        # executable off-key, which no runtime test catches
+        cbr = cln.get("violations_by_rule") or {}
+        checked += 1
+        n_uci = float(cbr.get("untracked-compile-input", 0))
+        if n_uci > 0:
+            reg.append(_finding(
+                "lint-compile-input", "untracked-compile-input", 0.0,
+                n_uci, 0.0, "regression",
+                "a conf/global read traces into a device program off the "
+                "cache key (the kernelBlockRows bug class) — exact-mode: "
+                "zero tolerance"))
 
     return {"ok": not reg, "regressions": reg, "improvements": imp,
             "checked": checked}

@@ -790,12 +790,361 @@ def test_lock_order_sees_class_and_module_locks_across_files(lint):
     assert len(rep.violations) == 2
 
 
+# ----------------------------------- the traced-region analysis core (PR 18)
+def test_traced_regions_factory_marks_nested_not_host_body(lint):
+    """Seeding `data_parallel(_make(3))` traces the factory's RETURNED
+    closure (the nested def), never the factory's host-side body — the
+    distinction that keeps host-side conf resolvers unflagged."""
+    project = lint.Project.from_sources({"sml_tpu/a.py": (
+        "def _make(w):\n"
+        "    def prog(x):\n"
+        "        return step(x) * w\n"
+        "    return prog\n"
+        "def step(x):\n"
+        "    return x + 1\n"
+        "def getter():\n"
+        "    return data_parallel(_make(3))\n")})
+    a = lint.traced.analyze(project)
+    assert "sml_tpu/a.py::_make.prog" in a.regions
+    assert "sml_tpu/a.py::_make.prog" in a.shard
+    # call-graph propagation reaches the helper the program calls
+    assert "sml_tpu/a.py::step" in a.shard
+    # the factory body and the getter stay host-side
+    assert "sml_tpu/a.py::_make" not in a.regions
+    assert "sml_tpu/a.py::getter" not in a.regions
+
+
+def test_traced_regions_scan_body_inherits_shardedness(lint):
+    """`lax.scan(round_fn, ...)` inside a shard-mapped program traces
+    its body, and the body inherits the site's shardedness (the
+    tree_impl round-function composition)."""
+    project = lint.Project.from_sources({"sml_tpu/b.py": (
+        "def make_round(y):\n"
+        "    def round_fn(c, t):\n"
+        "        return c + y, t\n"
+        "    return round_fn\n"
+        "def prog(x, y):\n"
+        "    rf = make_round(y)\n"
+        "    out, _ = jax.lax.scan(rf, x, y)\n"
+        "    return out\n"
+        "g = shard_map_compat(prog, mesh=m, in_specs=a, out_specs=b)\n")})
+    a = lint.traced.analyze(project)
+    assert "sml_tpu/b.py::prog" in a.shard
+    assert "sml_tpu/b.py::make_round.round_fn" in a.shard
+    # the factory is CALLED from inside the traced program, so unlike
+    # the host-getter case its body does execute at trace time
+    assert "sml_tpu/b.py::make_round" in a.regions
+
+
+def test_traced_regions_agree_with_dispatch_allowlist(lint):
+    """The region map reuses dispatch_bypass.ALLOWLIST verbatim: a seed
+    inside a blessed owner is labelled sanctioned, so the two rules can
+    never disagree about what a compile site is."""
+    project = lint.Project.from_sources({"sml_tpu/ml/_staging.py": (
+        "def data_parallel(fn):\n"
+        "    def wrapped(*a):\n"
+        "        return fn(*a)\n"
+        "    return jax.jit(wrapped)\n")})
+    a = lint.traced.analyze(project)
+    origin = a.regions["sml_tpu/ml/_staging.py::data_parallel.wrapped"]
+    assert origin.startswith("sanctioned-")
+
+
+# --------------------------------- rule 11: collective-axis-discipline (PR 18)
+CAD = ["collective-axis-discipline"]
+
+
+def test_collective_axis_flags_undeclared_literal(lint):
+    rep = run_on(lint, {
+        "sml_tpu/parallel/mesh.py": "DATA_AXIS = 'data'\n",
+        "sml_tpu/a.py": (
+            "def prog(x):\n"
+            "    return coll.psum(x, axis='modle')\n"
+            "def getter(m, s, o):\n"
+            "    return shard_map_compat(prog, mesh=m, in_specs=s,"
+            " out_specs=o)\n")}, rules=CAD)
+    assert rules_fired(rep) == CAD
+    assert "'modle'" in rep.violations[0].message
+    assert "data" in rep.violations[0].message
+
+
+def test_collective_axis_flags_unreachable_collective(lint):
+    """A psum in code no shard-mapped region reaches has no axis bound:
+    both the never-traced and the jit-without-shard_map flavors flag."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "DATA_AXIS = 'data'\n"
+        "def helper(x):\n"
+        "    return coll.psum(x)\n")}, rules=CAD)
+    assert len(rep.violations) == 1
+    assert "never traced" in rep.violations[0].message
+    rep2 = run_on(lint, {"sml_tpu/b.py": (
+        "DATA_AXIS = 'data'\n"
+        "def prog(x):\n"
+        "    return coll.pmean(x)\n"
+        "g = jax.jit(prog)\n")}, rules=CAD)
+    assert len(rep2.violations) == 1
+    assert "not shard-mapped" in rep2.violations[0].message
+
+
+def test_collective_axis_clean_on_declared_axis_in_shard_region(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "DATA_AXIS = 'data'\n"
+        "def prog(x):\n"
+        "    return coll.psum(x, axis=DATA_AXIS)\n"
+        "def getter():\n"
+        "    return data_parallel(prog)\n")}, rules=CAD)
+    assert rep.clean
+
+
+def test_collective_axis_exempts_wrapper_composition(lint):
+    """collectives.py wrappers composing each other (psum_scalars ->
+    psum, masked_count -> psum) are the sanctioned surface itself."""
+    rep = run_on(lint, {"sml_tpu/parallel/collectives.py": (
+        "DATA_AXIS = 'data'\n"
+        "def psum(x, axis=DATA_AXIS):\n"
+        "    return lax.psum(x, axis)\n"
+        "def masked_count(m, axis=DATA_AXIS):\n"
+        "    return psum(m, axis)\n")}, rules=CAD)
+    assert rep.clean
+
+
+# ------------------------------------- rule 12: divergent-collective (PR 18)
+DIV = ["divergent-collective"]
+
+
+def test_divergent_flags_conf_branch_around_psum(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(x):\n"
+        "    if GLOBAL_CONF.getBool('sml.x.flag'):\n"
+        "        x = coll.psum(x)\n"
+        "    return x\n"
+        "p = data_parallel(prog)\n")}, rules=DIV)
+    assert rules_fired(rep) == DIV
+    assert "sml.x.flag" in rep.violations[0].message
+
+
+def test_divergent_flags_data_dependent_branch(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(x):\n"
+        "    if x.shape[0] > 1024:\n"
+        "        return coll.pmean(x)\n"
+        "    return x\n"
+        "def getter(m, s, o):\n"
+        "    return shard_map_compat(prog, mesh=m, in_specs=s,"
+        " out_specs=o)\n")}, rules=DIV)
+    assert len(rep.violations) == 1
+    assert "x.shape" in rep.violations[0].message
+
+
+def test_divergent_clean_when_branch_is_host_side_getter(lint):
+    """The sanctioned pattern: conf selects BETWEEN whole programs on
+    the host; each traced program launches unconditionally."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog_a(x):\n"
+        "    return coll.psum(x)\n"
+        "def prog_b(x):\n"
+        "    return x\n"
+        "def getter():\n"
+        "    if GLOBAL_CONF.getBool('sml.x.flag'):\n"
+        "        return data_parallel(prog_a)\n"
+        "    return data_parallel(prog_b)\n")}, rules=DIV)
+    assert rep.clean
+
+
+def test_divergent_clean_on_static_closure_branch(lint):
+    """A branch on a trace-time-constant closure value (tree_impl's
+    `if subtract:`) specialises the program; it cannot diverge across
+    hosts that built from the same key."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def make(subtract):\n"
+        "    def prog(x):\n"
+        "        if subtract:\n"
+        "            x = coll.psum(x)\n"
+        "        return x\n"
+        "    return prog\n"
+        "def getter(subtract):\n"
+        "    return data_parallel(make(subtract))\n")}, rules=DIV)
+    assert rep.clean
+
+
+# ---------------------------------- rule 13: untracked-compile-input (PR 18)
+UCI = ["untracked-compile-input"]
+
+
+def test_untracked_input_fires_on_pr9_kernel_block_rows_shape(lint):
+    """The PR-9 bug, reconstructed: `_block_plan` falling back to a
+    live conf read at TRACE time, reached from a jitted program whose
+    cache key cannot see the value. This exact shape shipped in
+    native/hist_kernel.py and native/traverse_kernel.py until PR 18."""
+    rep = run_on(lint, {
+        "sml_tpu/native/k.py": (
+            "def _block_plan(n, interpret, block_rows):\n"
+            "    if interpret:\n"
+            "        return 1, n\n"
+            "    if block_rows is None:\n"
+            "        from ..conf import GLOBAL_CONF\n"
+            "        block_rows ="
+            " GLOBAL_CONF.getInt('sml.tree.kernelBlockRows')\n"
+            "    return 2, block_rows\n"
+            "def hist(x, block_rows=None):\n"
+            "    nblk, blk = _block_plan(x.shape[0], False, block_rows)\n"
+            "    return pl.pallas_call(kern, grid=(nblk,))(x)\n"),
+        "sml_tpu/ml/t.py": (
+            "_cache = {}\n"
+            "def build(x):\n"
+            "    return hist(x)\n"
+            "def _compiled(mesh):\n"
+            "    key = (id(mesh),)\n"
+            "    if key not in _cache:\n"
+            "        _cache[key] = jax.jit(build)\n"
+            "    return _cache[key]\n")}, rules=UCI)
+    assert rules_fired(rep) == UCI
+    assert any("sml.tree.kernelBlockRows" in v.message
+               and v.path == "sml_tpu/native/k.py"
+               for v in rep.violations)
+
+
+def test_untracked_input_silent_on_pr18_fixed_shape(lint):
+    """The fix: resolve host-side, close over the value, ride the key.
+    No conf read remains inside any traced region and the carried name
+    is in the key tuple — both legs stay silent."""
+    rep = run_on(lint, {
+        "sml_tpu/native/k.py": (
+            "def _block_plan(n, interpret, block_rows):\n"
+            "    if interpret or not block_rows:\n"
+            "        return 1, n\n"
+            "    return 2, block_rows\n"
+            "def hist(x, block_rows=None):\n"
+            "    nblk, blk = _block_plan(x.shape[0], False, block_rows)\n"
+            "    return pl.pallas_call(kern, grid=(nblk,))(x)\n"),
+        "sml_tpu/ml/t.py": (
+            "_cache = {}\n"
+            "def _rows():\n"
+            "    return GLOBAL_CONF.getInt('sml.tree.kernelBlockRows')\n"
+            "def _compiled(mesh):\n"
+            "    brows = _rows()\n"
+            "    def build(x):\n"
+            "        return hist(x, block_rows=brows)\n"
+            "    key = (id(mesh), brows)\n"
+            "    if key not in _cache:\n"
+            "        _cache[key] = jax.jit(build)\n"
+            "    return _cache[key]\n")}, rules=UCI)
+    assert rep.clean, "\n" + rep.format()
+
+
+def test_untracked_input_key_gap_via_build_argument_flow(lint):
+    """Leg B: a conf value flowing into the program build through a
+    carrier name that rides NEITHER the key tuple nor the prewarm
+    signature is a gap — adding the carrier to the key silences it."""
+    gap_src = (
+        "_cache = {}\n"
+        "def _choice():\n"
+        "    return GLOBAL_CONF.get('sml.tree.kernel')\n"
+        "def _make(kernel):\n"
+        "    def prog(x):\n"
+        "        return x\n"
+        "    return prog\n"
+        "def _compiled(mesh):\n"
+        "    kernel = _choice()\n"
+        "    key = (id(mesh),)\n"
+        "    if key not in _cache:\n"
+        "        _cache[key] = jax.jit(_make(kernel))\n"
+        "    return _cache[key]\n")
+    rep = run_on(lint, {"sml_tpu/ml/u.py": gap_src}, rules=UCI)
+    assert len(rep.violations) == 1
+    v = rep.violations[0]
+    assert "sml.tree.kernel" in v.message and "`kernel`" in v.message
+    fixed = gap_src.replace("key = (id(mesh),)", "key = (id(mesh), kernel)")
+    rep2 = run_on(lint, {"sml_tpu/ml/u.py": fixed}, rules=UCI)
+    assert rep2.clean, "\n" + rep2.format()
+
+
+def test_untracked_input_flags_rebindable_global_in_traced_region(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "_SCALE = 1\n"
+        "def bump():\n"
+        "    global _SCALE\n"
+        "    _SCALE = 2\n"
+        "def prog(x):\n"
+        "    return x * _SCALE\n"
+        "p = data_parallel(prog)\n")}, rules=UCI)
+    assert len(rep.violations) == 1
+    assert "_SCALE" in rep.violations[0].message
+
+
+def test_untracked_input_allows_prewarm_signature_coverage(lint):
+    """A conf value that rides the prewarm-manifest signature dict is
+    tracked even when the key tuple omits it (the manifest replays the
+    build with the recorded value)."""
+    rep = run_on(lint, {"sml_tpu/ml/u.py": (
+        "_cache = {}\n"
+        "def _choice():\n"
+        "    return GLOBAL_CONF.get('sml.tree.kernel')\n"
+        "def _make(kernel):\n"
+        "    def prog(x):\n"
+        "        return x\n"
+        "    return prog\n"
+        "def _compiled(mesh):\n"
+        "    kernel = _choice()\n"
+        "    record('fit', {'kernel': _choice()})\n"
+        "    key = (id(mesh),)\n"
+        "    if key not in _cache:\n"
+        "        _cache[key] = jax.jit(_make(kernel))\n"
+        "    return _cache[key]\n")}, rules=UCI)
+    assert rep.clean, "\n" + rep.format()
+
+
+# -------------------------------------- rule 14: per-chip-key-fold (PR 18)
+PKF = ["per-chip-key-fold"]
+
+
+def test_key_fold_flags_direct_axis_index_fold(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(key, x):\n"
+        "    k = jax.random.fold_in(key, coll.axis_index())\n"
+        "    return jax.random.uniform(k, x.shape)\n")}, rules=PKF)
+    assert rules_fired(rep) == PKF
+    assert "axis_index" in rep.violations[0].message
+    assert "_sliced_draw" in rep.violations[0].message
+
+
+def test_key_fold_flags_fold_via_assigned_index(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(key, x):\n"
+        "    idx = coll.axis_index()\n"
+        "    k = jax.random.fold_in(key, idx)\n"
+        "    return k\n")}, rules=PKF)
+    assert len(rep.violations) == 1
+    assert "`idx`" in rep.violations[0].message
+
+
+def test_key_fold_allows_round_counter_fold(lint):
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(key, t):\n"
+        "    return jax.random.fold_in(key, t)\n")}, rules=PKF)
+    assert rep.clean
+
+
+def test_key_fold_allows_sanctioned_sliced_draw(lint):
+    """The PR-6 replicated-key slice: one draw from the shared key,
+    this chip's rows by dynamic_slice — no fold, no finding."""
+    rep = run_on(lint, {"sml_tpu/a.py": (
+        "def prog(key, n):\n"
+        "    full = jax.random.uniform(key, (n * 8,))\n"
+        "    i = coll.axis_index('data')\n"
+        "    return jax.lax.dynamic_slice(full, (i * n,), (n,))\n")},
+        rules=PKF)
+    assert rep.clean
+
+
 # ------------------------------------------------------------ the live tree
 EXPECTED_RULES = {"host-sync-in-hot-path", "dispatch-bypass",
                   "conf-key-registry", "donation-after-use",
                   "obs-taxonomy", "no-wallclock-in-engine",
                   "unsharded-device-put", "race-unguarded-shared-write",
-                  "race-check-then-use", "lock-order"}
+                  "race-check-then-use", "lock-order",
+                  "collective-axis-discipline", "divergent-collective",
+                  "untracked-compile-input", "per-chip-key-fold"}
 
 
 def test_live_tree_clean_modulo_baseline(lint):
@@ -806,5 +1155,6 @@ def test_live_tree_clean_modulo_baseline(lint):
 
 def test_rule_catalogue_registered(lint):
     assert EXPECTED_RULES <= set(lint.RULES)
+    assert len(EXPECTED_RULES) == 14
     for name in EXPECTED_RULES:
         assert lint.RULES[name].doc

@@ -464,3 +464,34 @@ def test_goldens_unchanged_on_ml06_ml07_fits(spark, kernel_conf):
         tol = max(1e-3, 1e-5 * abs(want))  # the golden gate's own tol
         assert abs(float(got) - want) < tol, \
             f"{key}: got {got}, golden {want}"
+
+
+def test_block_plan_never_reads_conf_at_trace_time():
+    """PR-18 regression (the untracked-compile-input lint fix): the
+    accumulate kernel's block plan is a pure function of its arguments.
+    The pre-fix fallback read `sml.tree.kernelBlockRows` from live conf
+    at TRACE time, silently diverging from the cache-keyed value that
+    `tree_impl._kernel_block_rows` resolved host-side."""
+    import inspect
+
+    from sml_tpu.native import hist_kernel as hk
+
+    src = inspect.getsource(hk._block_plan)
+    assert "GLOBAL_CONF" not in src, \
+        "trace-time conf read reintroduced into _block_plan"
+    # None/0 now mean "no blocking": one full block, conf untouched
+    assert hk._block_plan(6000, False, None) == (1, 6000)
+    assert hk._block_plan(6000, False, 0) == (1, 6000)
+    assert hk._block_plan(6000, True, 4096) == (1, 6000)
+    # an explicit host-resolved target still blocks as before
+    nblk, blk = hk._block_plan(6000, False, 1024)
+    assert nblk * blk == 6000 and blk <= 1024
+    # and the plan is insensitive to the live conf value — the knob
+    # only matters where it is keyed (the host-side resolver)
+    prev = GLOBAL_CONF.get("sml.tree.kernelBlockRows")
+    try:
+        GLOBAL_CONF.set("sml.tree.kernelBlockRows", 7)
+        assert hk._block_plan(6000, False, None) == (1, 6000)
+        assert hk._block_plan(6000, False, 1024) == (nblk, blk)
+    finally:
+        GLOBAL_CONF.set("sml.tree.kernelBlockRows", prev)

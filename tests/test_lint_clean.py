@@ -27,6 +27,10 @@ def test_graftlint_clean_and_jax_free():
         "rc = m.main(['--json'])\n"
         "assert 'jax' not in sys.modules, 'linter imported jax'\n"
         "assert 'sml_tpu' not in sys.modules, 'linter imported sml_tpu'\n"
+        "assert 'graftlint.traced' in sys.modules, "
+        "'traced-region core not loaded standalone'\n"
+        "assert 'graftlint.threads' in sys.modules, "
+        "'thread-role core not loaded standalone'\n"
         "sys.exit(rc)\n")
     out = subprocess.run([sys.executable, "-c", probe], cwd=REPO,
                          capture_output=True, text=True, timeout=120)
@@ -35,6 +39,17 @@ def test_graftlint_clean_and_jax_free():
     assert payload["clean"] is True
     assert len(payload["rules"]) >= 6
     assert payload["violations"] == []
+    # the extended machine surface: per-rule wall time for every active
+    # rule, and per-violation status lists (active list is empty on the
+    # clean tree; the suppressed list carries pragma/baseline entries)
+    assert set(payload["rule_times"]) == set(payload["rules"])
+    assert all(t >= 0 for t in payload["rule_times"].values())
+    assert payload["suppressed_violations"], "suppression detail missing"
+    assert {sv["status"] for sv in payload["suppressed_violations"]} \
+        <= {"pragma", "baseline"}
+    n_pragma = sum(1 for sv in payload["suppressed_violations"]
+                   if sv["status"] == "pragma")
+    assert n_pragma == payload["suppressed"]["pragma"]
 
 
 def test_single_rule_run_is_clean_on_committed_tree():
@@ -109,6 +124,73 @@ def test_exit_code_contract(tmp_path, capsys):
     assert out.returncode == 2
 
 
+def test_changed_only_mode():
+    """--changed-only keeps the exit-code contract: 0 on the clean tree
+    against HEAD, 2 on a ref git cannot resolve; --json records the
+    filter ref. The full tree is still analysed (cross-file rules), so
+    the rule list stays complete."""
+    out = subprocess.run([sys.executable, RUNNER, "--changed-only",
+                          "HEAD", "--json"], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["clean"] is True
+    assert payload["changed_only"] == "HEAD"
+    assert len(payload["rules"]) >= 14
+    bad = subprocess.run([sys.executable, RUNNER, "--changed-only",
+                          "no-such-ref-xyz"], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "--changed-only" in bad.stderr
+
+
+def test_changed_only_filters_to_changed_files(tmp_path):
+    """In a scratch git repo: a committed violation plus a changed-file
+    violation — full run reports both (exit 1), --changed-only HEAD
+    reports ONLY the changed file's, and a run scoped to an unchanged
+    ref-clean file reports none."""
+    import shutil as _sh
+    if _sh.which("git") is None:
+        pytest.skip("git unavailable")
+    _sh.copytree(os.path.join(REPO, "scripts"), tmp_path / "scripts",
+                 ignore=_sh.ignore_patterns("__pycache__"))
+    _sh.copytree(os.path.join(REPO, "sml_tpu", "lint"),
+                 tmp_path / "sml_tpu" / "lint",
+                 ignore=_sh.ignore_patterns("__pycache__"))
+    os.makedirs(tmp_path / "sml_tpu" / "obs")
+    _sh.copy(os.path.join(REPO, "sml_tpu", "obs", "taxonomy.py"),
+             tmp_path / "sml_tpu" / "obs" / "taxonomy.py")
+    (tmp_path / "sml_tpu" / "old.py").write_text(
+        "import time\nT0 = time.time()\n")
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       env=env, capture_output=True, timeout=30)
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (tmp_path / "sml_tpu" / "new.py").write_text(
+        "import time\nT1 = time.time()\n")
+    runner = str(tmp_path / "scripts" / "graftlint.py")
+    full = subprocess.run([sys.executable, runner, "--root",
+                           str(tmp_path), "--json"], cwd=tmp_path,
+                          capture_output=True, text=True, timeout=120)
+    assert full.returncode == 1
+    full_paths = {v["path"] for v in json.loads(full.stdout)["violations"]}
+    assert {"sml_tpu/old.py", "sml_tpu/new.py"} <= full_paths
+    part = subprocess.run([sys.executable, runner, "--root",
+                           str(tmp_path), "--changed-only", "HEAD",
+                           "--json"], cwd=tmp_path, capture_output=True,
+                          text=True, timeout=120)
+    assert part.returncode == 1
+    part_paths = {v["path"] for v in json.loads(part.stdout)["violations"]}
+    assert "sml_tpu/new.py" in part_paths
+    assert "sml_tpu/old.py" not in part_paths
+
+
 def test_regress_flags_lint_block_loss_and_violation_growth():
     """obs/regress.py judges the sidecar `lint` block: a vanished block
     (sidecar candidates), an unsuppressed-violation increase, or an
@@ -119,7 +201,7 @@ def test_regress_flags_lint_block_loss_and_violation_growth():
                                       "regress.py"))
     regress = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(regress)
-    lint_block = {"rules": 10, "files": 104, "violations": 0,
+    lint_block = {"rules": 14, "files": 119, "violations": 0,
                   "suppressed_pragma": 88, "suppressed_baseline": 3}
     base = regress.normalize({"legs": {}, "lint": dict(lint_block)})
     same = regress.normalize({"legs": {}, "lint": dict(lint_block)})
@@ -140,6 +222,25 @@ def test_regress_flags_lint_block_loss_and_violation_growth():
     # driver records can never carry the block: exempt from coverage
     rec = regress.normalize({"parsed": {}, "tail": ""})
     assert regress.compare(base, rec)["ok"]
+    # absolute >=14-rule floor, judged even against a pre-PR-18 base
+    # record that carried fewer rules
+    old_base = regress.normalize({"legs": {},
+                                  "lint": dict(lint_block, rules=10)})
+    below = regress.normalize({"legs": {},
+                               "lint": dict(lint_block, rules=13)})
+    res4 = regress.compare(old_base, below)
+    assert any(f["kind"] == "lint-rule-floor" for f in res4["regressions"])
+    # untracked-compile-input is exact-mode: ONE occurrence regresses,
+    # even when the total violation count did not grow vs base
+    uci = regress.normalize({"legs": {}, "lint": dict(
+        lint_block, violations=0,
+        violations_by_rule={"untracked-compile-input": 1})})
+    res5 = regress.compare(base, uci)
+    assert any(f["kind"] == "lint-compile-input"
+               for f in res5["regressions"])
+    clean_by_rule = regress.normalize({"legs": {}, "lint": dict(
+        lint_block, violations_by_rule={})})
+    assert regress.compare(base, clean_by_rule)["ok"]
 
 
 def test_bench_lint_gate_refuses_dirty_tree(tmp_path):

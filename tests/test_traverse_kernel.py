@@ -354,3 +354,32 @@ def test_regress_kernel_infer_rules(spark):
     lost_i2 = regress.compare(base_i, regress.normalize(
         {"legs": {}, "kernel_infer": dict(ib, replay_ok=False)}))
     assert any(f["key"] == "replay_ok" for f in lost_i2["regressions"])
+
+
+def test_block_plan_never_reads_conf_at_trace_time():
+    """PR-18 regression (the untracked-compile-input lint fix): the
+    traversal kernel's block plan is a pure function of its arguments.
+    The pre-fix fallback read `sml.infer.kernelBlockRows` from live
+    conf at TRACE time, silently diverging from the cache-keyed value
+    `inference.resolve_infer_kernel` resolved host-side."""
+    import inspect
+
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.native import traverse_kernel as tk
+
+    src = inspect.getsource(tk._block_plan)
+    assert "GLOBAL_CONF" not in src, \
+        "trace-time conf read reintroduced into _block_plan"
+    # None/0 now mean "no blocking": one full block, conf untouched
+    assert tk._block_plan(4096, False, None) == (1, 4096)
+    assert tk._block_plan(4096, False, 0) == (1, 4096)
+    assert tk._block_plan(4096, True, 256) == (1, 4096)
+    nblk, blk = tk._block_plan(4096, False, 256)
+    assert nblk * blk == 4096 and blk <= 256
+    prev = GLOBAL_CONF.get("sml.infer.kernelBlockRows")
+    try:
+        GLOBAL_CONF.set("sml.infer.kernelBlockRows", 7)
+        assert tk._block_plan(4096, False, None) == (1, 4096)
+        assert tk._block_plan(4096, False, 256) == (nblk, blk)
+    finally:
+        GLOBAL_CONF.set("sml.infer.kernelBlockRows", prev)
