@@ -324,6 +324,13 @@ def run_serving_leg(lr_model, test, timings, flops, metrics, eng=None):
     reqs = max(delta("serve.requests"), 1.0)
     metrics["serve_p50_ms"] = round(hist.quantile(0.50), 3) if hist else 0.0
     metrics["serve_p99_ms"] = round(hist.quantile(0.99), 3) if hist else 0.0
+    # like-for-like annotation (docs/LOADGEN.md): these percentiles come
+    # from CLOSED-LOOP clients with no arrival schedule — they
+    # self-throttle when the batcher queues, so they are NOT comparable
+    # to open-loop numbers. The regress sentry only compares
+    # serve_p50/p99 between records whose serve_closed_loop annotations
+    # agree; the open-loop story lives in the `load` block
+    metrics["serve_closed_loop"] = 1.0
     metrics["serve_slo_burn_rate"] = slo["burn_rate"]
     # the LITERAL worst request of the leg, by trace-id exemplar
     # (obs/_context.py): the id to chase through an exported trace's
@@ -1958,6 +1965,14 @@ def ct_main(rows: int) -> None:
 
 
 FLEET_REQUESTS = 10_000
+#: per-client pacing interval for the fleet leg's clients (ms): each
+#: client INTENDS to send request k at epoch + k*interval and charges
+#: latency from that intended instant (wrk2-style re-basing) — a
+#: completion that arrives late delays the send but not the clock, so
+#: the queueing the old send-time stamp hid is now on the record. Small
+#: enough that a saturated fleet never actually sleeps (the load shape
+#: the proofs depend on is unchanged)
+FLEET_PACE_MS = 5.0
 
 
 def run_fleet(requests: int = FLEET_REQUESTS) -> dict:
@@ -2055,13 +2070,26 @@ def run_fleet(requests: int = FLEET_REQUESTS) -> dict:
         hung = [0]
         lat_lock = threading.Lock()
 
+        # coordinated-omission fix (docs/LOADGEN.md): each client paces
+        # a per-client SCHEDULE (request k intended at epoch +
+        # k*FLEET_PACE_MS) and charges latency from the INTENDED
+        # arrival, not the post-completion send time — when the fleet
+        # queues and delays a completion, the next request's clock has
+        # already started, so the queueing lands on the record instead
+        # of silently slowing the client's arrival rate
+        interval = FLEET_PACE_MS / 1e3
+
         def client(cls, n):
             my_lat, my_shed = [], 0
-            for _ in range(n):
-                t0 = time.perf_counter()
+            epoch = time.perf_counter()
+            for k in range(n):
+                intended = epoch + k * interval
+                spare = intended - time.perf_counter()
+                if spare > 0:
+                    time.sleep(spare)
                 try:
                     router.submit(X, cls).result(30.0)
-                    my_lat.append((time.perf_counter() - t0) * 1e3)
+                    my_lat.append((time.perf_counter() - intended) * 1e3)
                 except RequestShed:
                     my_shed += 1
                 except TimeoutError:
@@ -2172,6 +2200,13 @@ def run_fleet(requests: int = FLEET_REQUESTS) -> dict:
                     "breaches": slo["breaches"]},
             "priority": per_class,
             "priority_order_ok": bool(priority_order_ok),
+            # like-for-like annotation: these latencies come from
+            # CLOSED-LOOP clients (re-based on intended arrivals, but
+            # still self-throttling past one in-flight request each) —
+            # the regress sentry only compares p99s between blocks
+            # whose closed_loop flags agree (docs/LOADGEN.md)
+            "closed_loop": True,
+            "pace_ms": FLEET_PACE_MS,
             "hung_futures": int(hung[0]),
             "reroutes": counters.get("fleet.reroutes", 0.0),
             "scale": {"up_events": up_events, "down_events": down_events,
@@ -2268,6 +2303,283 @@ def fleet_main(requests: int) -> None:
         "legs_file": "bench_legs.json",
     }))
     if not block["fleet_ok"]:
+        sys.exit(1)
+
+
+#: the committed multi-phase open-loop trace for `--load` (seconds of
+#: each phase at the nominal req/s BEFORE --load-scale): steady Poisson,
+#: a 3x burst (mean-preserving on/off modulation), then a diurnal-shaped
+#: ramp. The seed makes the schedule byte-reproducible.
+LOAD_TRACE_SEED = 19
+LOAD_PHASES = (("steady", 6.0, 30.0, None, "poisson"),
+               ("burst", 6.0, 30.0, None, "bursty"),
+               ("ramp", 6.0, 15.0, 45.0, "poisson"))
+LOAD_WIDTHS = ((8, 0.70), (32, 0.22), (128, 0.06), (256, 0.02))
+LOAD_CLASSES = (("high", 0.2), ("normal", 0.6), ("low", 0.2))
+#: open-loop honesty tolerance for the bench leg (µs): fire-lag past
+#: this counts load.overrun. Much wider than the 5 ms library default —
+#: the bench box can be a 1-core container where a just-woken driver
+#: worker waits behind a whole herd of GIL slices (every future the OFF
+#: run's mis-tuned flush resolves wakes a parked thread) before it can
+#: stamp its fire. The value is RECORDED in the block, so the claim
+#: "zero overruns" always names the tolerance it was measured at
+LOAD_OVERRUN_MICROS = 100_000
+#: mis-tuned static flush deadline for the engineering-OFF run (µs): a
+#: plausible hand-tuned value that eats most of the 50 ms SLO budget in
+#: queueing — exactly what the auto-tuner exists to fix
+LOAD_OFF_FLUSH_MICROS = 40_000
+LOAD_SLO_MILLIS = 50
+
+
+def run_load(scale: float = 1.0) -> dict:
+    """`--load`: the open-loop trace-driven load proof (docs/LOADGEN.md)
+    — replay the committed steady→3x-burst→ramp `TraceSpec` through
+    `loadgen.OpenLoopDriver` against a warm 2-replica fleet TWICE:
+
+    - OFF: static mis-tuned flush deadline (LOAD_OFF_FLUSH_MICROS), no
+      burst-anticipating admission, no speculative prewarm — honest
+      open-loop tails of a hand-tuned fleet;
+    - ON: `sml.serve.flushAutoTune` + `sml.fleet.burstSlopeHorizonSec`
+      + `loadgen.prewarm_widths` — the tail-engineering ladder the
+      harness motivates.
+
+    The sidecar `load` block carries the ON run's per-phase/per-class
+    p50/p99/p99.9 with worst-request trace exemplars (round-tripped
+    through the flight-recorder ring), the overrun count (must be 0 —
+    an overrun means the harness, not the fleet, shaped the tails), and
+    the on-vs-off p99.9 delta on the burst phase. obs/regress.py flags
+    a vanished block, tail regressions, overrun growth, or a lost
+    engineering win."""
+    import shutil
+    import tempfile
+
+    import jax
+    import pandas as pd
+
+    import sml_tpu.tracking as mlflow
+    from sml_tpu import TpuSession, obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.fleet import ReplicaPool, Router
+    from sml_tpu.loadgen import (OpenLoopDriver, PhaseSpec, TraceSpec,
+                                 prewarm_widths)
+    from sml_tpu.ml import Pipeline
+    from sml_tpu.ml.feature import VectorAssembler
+    from sml_tpu.ml.regression import LinearRegression
+    from sml_tpu.tracking import _store
+    from sml_tpu.utils.profiler import PROFILER
+
+    spec = TraceSpec(
+        phases=tuple(PhaseSpec(name, dur, rate * scale,
+                               None if rate_end is None
+                               else rate_end * scale, arrival)
+                     for name, dur, rate, rate_end, arrival
+                     in LOAD_PHASES),
+        widths=LOAD_WIDTHS, classes=LOAD_CLASSES, seed=LOAD_TRACE_SEED)
+    requests = spec.compile()
+
+    prev = {k: GLOBAL_CONF.get(k) for k in (
+        "sml.obs.enabled", "sml.profiler.enabled", "sml.obs.ringEvents",
+        "sml.serve.sloMillis", "sml.serve.flushAutoTune",
+        "sml.fleet.burstSlopeHorizonSec", "sml.load.overrunMicros")}
+    prev_uri = _store.get_tracking_uri()
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    GLOBAL_CONF.set("sml.profiler.enabled", True)
+    # per-request exemplar round-trip scans the ring for every phase's
+    # worst request: size it so two full replays cannot evict evidence
+    GLOBAL_CONF.set("sml.obs.ringEvents", 1 << 18)
+    GLOBAL_CONF.set("sml.serve.sloMillis", LOAD_SLO_MILLIS)
+    GLOBAL_CONF.set("sml.load.overrunMicros", LOAD_OVERRUN_MICROS)
+    # on a 1-core box the default 5 ms GIL switch interval makes a
+    # just-woken driver worker wait many whole slices behind parked
+    # scorer threads before it can even STAMP its fire time — that lag
+    # books as a harness overrun. Shorter slices trade a little
+    # throughput for honest open-loop pickup; restored in the finally
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    tmp = tempfile.mkdtemp(prefix="sml-load-bench-")
+    mlflow.set_tracking_uri(os.path.join(tmp, "runs"))
+    spark = TpuSession.builder.appName("load-bench").getOrCreate()
+    timeout_s = float(GLOBAL_CONF.get("sml.load.resultTimeoutSec"))
+
+    def fit():
+        rng = np.random.default_rng(3)
+        pdf = pd.DataFrame({"a": rng.normal(size=4000),
+                            "b": rng.normal(size=4000)})
+        pdf["y"] = 2.0 * pdf["a"] - pdf["b"] + 1.0 \
+            + rng.normal(0, 0.1, len(pdf))
+        va = VectorAssembler(inputCols=["a", "b"], outputCol="features")
+        return Pipeline(stages=[va, LinearRegression(labelCol="y")]) \
+            .fit(spark.createDataFrame(pdf))
+
+    def one_run(engineering: bool) -> dict:
+        """One full replay of the committed trace against a fresh
+        2-replica fleet; returns the driver's report plus the fleet's
+        final flush deadlines."""
+        GLOBAL_CONF.set("sml.serve.flushAutoTune", bool(engineering))
+        GLOBAL_CONF.set("sml.fleet.burstSlopeHorizonSec",
+                        5.0 if engineering else 0.0)
+        pool = ReplicaPool(
+            "load-bench-model", replicas=2, canary_fraction=0.0,
+            flush_micros=LOAD_OFF_FLUSH_MICROS, queue_rows=4096,
+            timeout_millis=0, host_fallback=True,
+            blackbox_dir=os.path.join(tmp, "blackbox"))
+        try:
+            router = Router(pool,
+                            priorities=[c for c, _ in LOAD_CLASSES])
+
+            def score(X, priority, model):
+                return router.score(X, priority, timeout=timeout_s)
+
+            # both runs see warm per-bucket programs (the suite's
+            # compile story is measured elsewhere); the ON run
+            # additionally exercises the declared-width-mix prewarm
+            # path the trace's spec feeds. Beyond the declared widths,
+            # also warm the AGGREGATE buckets a backed-up flush can
+            # reach (the batcher concatenates its whole queue, so the
+            # bucketed batch width exceeds any single request's) — a
+            # mid-replay compile stalls the 1-core interpreter long
+            # enough to book harness overruns
+            for rows in [w for w, _ in LOAD_WIDTHS] + [512, 1024, 2048]:
+                score(np.zeros((rows, 2), dtype=np.float32), "high",
+                      None)
+            prewarm_stats = None
+            if engineering:
+                prewarm_stats = prewarm_widths(
+                    lambda X: score(X, "high", None), spec,
+                    feature_dim=2)
+            obs.METRICS.reset()  # each replay owns its distributions
+            # worker budget: a worker is just a thread parked in
+            # result(), so cover the trace's worst case — peak arrival
+            # rate x the worst observed latency (the OFF run's
+            # mis-tuned deadline backs requests up ~250ms under the 3x
+            # burst) — WITHOUT oversubscribing the host: on a 1-core
+            # bench box every extra runnable thread steals GIL slices
+            # from the dispatch loop and manufactures harness overruns
+            driver = OpenLoopDriver(score, requests, feature_dim=2,
+                                    workers=96)
+            report = driver.run()
+            report["flush_micros"] = sorted(
+                r.endpoint._batcher.flush_micros
+                for r in pool.replicas())
+            report["prewarm"] = prewarm_stats
+            return report
+        finally:
+            pool.close()
+
+    try:
+        obs.reset()
+        with mlflow.start_run():
+            mlflow.spark.log_model(
+                fit(), "model", registered_model_name="load-bench-model")
+        _store.set_version_stage("load-bench-model", 1, "Production")
+
+        off = one_run(engineering=False)
+        on = one_run(engineering=True)
+
+        # ---- exemplar round-trip: each phase's worst request must be
+        # recoverable in the flight-recorder ring by its trace id ----
+        ring_traces = set()
+        for ev in obs.RECORDER.events():
+            if ev.name == "trace.request":
+                ring_traces.add((ev.args or {}).get("trace"))
+        exemplars = {}
+        for name, ph in on["phases"].items():
+            hexid = ph.get("worst_trace")
+            exemplars[name] = bool(
+                hexid and int(hexid, 16) in ring_traces)
+        exemplar_ok = bool(exemplars) and all(exemplars.values())
+
+        off_p999 = off["phases"]["burst"]["p999_ms"]
+        on_p999 = on["phases"]["burst"]["p999_ms"]
+        counters = PROFILER.counters()
+        block = dict(on)
+        block.update({
+            "backend": jax.default_backend(),
+            "open_loop": True,
+            "trace": {
+                "seed": LOAD_TRACE_SEED,
+                "scale": float(scale),
+                "phases": [{"name": n, "duration_s": d, "rate": r,
+                            "rate_end": re_, "arrival": a}
+                           for n, d, r, re_, a in LOAD_PHASES],
+                "widths": [list(w) for w in LOAD_WIDTHS],
+                "classes": [list(c) for c in LOAD_CLASSES],
+            },
+            "slo_millis": LOAD_SLO_MILLIS,
+            "off_flush_micros": LOAD_OFF_FLUSH_MICROS,
+            "overrun_micros": LOAD_OVERRUN_MICROS,
+            "engineering": {
+                "off": {"p999_ms": off_p999,
+                        "p99_ms": off["phases"]["burst"]["p99_ms"],
+                        "overrun": off["overrun"],
+                        "flush_micros": off["flush_micros"]},
+                "on": {"p999_ms": on_p999,
+                       "p99_ms": on["phases"]["burst"]["p99_ms"],
+                       "overrun": on["overrun"],
+                       "flush_micros": on["flush_micros"]},
+                "delta_p999_ms": round(off_p999 - on_p999, 3),
+                "win": bool(on_p999 < off_p999),
+                "burst_tighten": counters.get("fleet.burst_tighten",
+                                              0.0),
+                "speculative_prewarm": on.get("prewarm"),
+            },
+            "exemplars_recovered": exemplars,
+            "exemplar_roundtrip_ok": bool(exemplar_ok),
+            "note": "open loop: TraceSpec(steady -> 3x burst -> ramp) "
+                    "replayed at the SCHEDULE through OpenLoopDriver "
+                    "over a 2-replica Router fleet; latency charged "
+                    "from scheduled arrival (docs/LOADGEN.md). "
+                    "engineering = flushAutoTune + burstSlope "
+                    "admission + declared-width prewarm, on vs off",
+        })
+        overruns = int(off["overrun"]) + int(on["overrun"])
+        ok = (overruns == 0
+              and block["engineering"]["win"]
+              and exemplar_ok
+              and int(on["served"]) > 0)
+        block["load_ok"] = bool(ok)
+        print(f"  load: {on['requests']} open-loop requests/run "
+              f"({len(on['phases'])} phases), overruns "
+              f"off/on = {off['overrun']}/{on['overrun']}, burst "
+              f"p99.9 off {off_p999:.1f}ms -> on {on_p999:.1f}ms "
+              f"({'WIN' if block['engineering']['win'] else 'LOST'}), "
+              f"exemplars {'ok' if exemplar_ok else 'LOST'}",
+              file=sys.stderr)
+        return block
+    finally:
+        sys.setswitchinterval(prev_switch)
+        for k, v in prev.items():
+            GLOBAL_CONF.set(k, v)
+        mlflow.set_tracking_uri(prev_uri)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def load_main(scale: float) -> None:
+    """Run the open-loop load leg standalone, merge the `load` block
+    into the bench sidecar, and print the short headline JSON last."""
+    block = run_load(scale)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["load"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "open-loop trace harness (coordinated-omission-free "
+                  "tails + tail-engineering on-vs-off)",
+        "value": 1.0 if block["load_ok"] else 0.0,
+        "unit": "1 = zero overruns + burst-phase p99.9 win (auto-tune "
+                "+ burst admission + width prewarm) + per-phase worst-"
+                "request exemplars recoverable",
+        "requests": block["requests"],
+        "overrun": block["overrun"],
+        "burst_p999_off_ms": block["engineering"]["off"]["p999_ms"],
+        "burst_p999_on_ms": block["engineering"]["on"]["p999_ms"],
+        "backend": block["backend"],
+        "legs_file": "bench_legs.json",
+    }))
+    if not block["load_ok"]:
         sys.exit(1)
 
 
@@ -2579,7 +2891,7 @@ def main():
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
             for block in ("multichip", "kernel", "kernel_infer", "scale",
-                          "drift", "lint", "ct", "fleet"):
+                          "drift", "lint", "ct", "fleet", "load"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):
@@ -2753,6 +3065,20 @@ if __name__ == "__main__":
                         default=FLEET_REQUESTS,
                         help="closed-loop request count for the "
                              "--fleet leg")
+    parser.add_argument("--load", action="store_true",
+                        help="run ONLY the open-loop trace-driven load "
+                             "proof (committed steady -> 3x-burst -> "
+                             "ramp TraceSpec replayed at the SCHEDULE "
+                             "through loadgen.OpenLoopDriver over a "
+                             "2-replica fleet, coordinated-omission-"
+                             "free per-phase/per-class p50/p99/p99.9, "
+                             "tail-engineering on-vs-off) and merge "
+                             "the `load` block into the bench sidecar; "
+                             "refuses a dirty tree like --lint; exits "
+                             "1 when any proof fails")
+    parser.add_argument("--load-scale", type=float, default=1.0,
+                        help="rate multiplier applied to every phase "
+                             "of the committed --load trace")
     parser.add_argument("--lint", action="store_true",
                         help="gate the run on a clean graftlint pass: a "
                              "bench record from a tree violating engine "
@@ -2769,10 +3095,15 @@ if __name__ == "__main__":
     if args.prewarm:
         from sml_tpu.conf import GLOBAL_CONF as _CONF0
         _CONF0.set("sml.prewarm.enabled", True)
-    if args.lint:
+    if args.lint or args.load:
+        # --load writes a committed, regress-judged record: like --lint,
+        # a tree violating engine invariants measures the wrong engine,
+        # so the gate refuses to record from one
         if run_graftlint() != 0:
             print("bench: refusing to record — graftlint found violations "
-                  "(fix them or run without --lint)", file=sys.stderr)
+                  "(fix them or run without "
+                  f"{'--lint' if args.lint else '--load'})",
+                  file=sys.stderr)
             sys.exit(1)
         _emit_lint_counters()
     entry = (pin_goldens if args.pin_goldens else
@@ -2786,6 +3117,8 @@ if __name__ == "__main__":
              if args.ct else
              (lambda: fleet_main(args.fleet_requests))
              if args.fleet else
+             (lambda: load_main(args.load_scale))
+             if args.load else
              (lambda: scale_main(args.rows))
              if args.rows else main)
     if args.blackbox_on_fail:

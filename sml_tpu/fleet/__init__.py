@@ -83,6 +83,23 @@ _register("sml.fleet.priorities", "high,normal,low", str,
           "order (it degrades through the endpoint's own host-fallback "
           "ladder instead of shedding). An SLO burn-rate past 1.0 "
           "halves every non-top class's share")
+_register("sml.fleet.burstSlopeWindowSec", 10.0, float,
+          "Burst-anticipating admission: the router fits a least-squares "
+          "slope to the SLO burn-rate samples inside this window. The "
+          "slope is the burst's LEADING edge — the windowed burn level "
+          "still averages a fresh burst away while the slope already "
+          "points at it")
+_register("sml.fleet.burstSlopeHorizonSec", 0.0, float,
+          "Burst-anticipating admission horizon: when the current burn "
+          "level plus its slope extrapolated this many seconds forward "
+          "crosses 1.0, non-top classes pre-tighten (counted "
+          "fleet.burst_tighten) BEFORE the budget is actually spent. "
+          "0 disables the predictor (admission reacts to the level only)")
+_register("sml.fleet.burstSlopeTighten", 0.5, float,
+          "Multiplier applied to every non-top class's admission share "
+          "while the burn-rate slope predicts a breach within "
+          "sml.fleet.burstSlopeHorizonSec (the pre-breach analogue of "
+          "the burn>1 halving)")
 _register("sml.fleet.autoscalePollSec", 2.0, float,
           "Interval of Autoscaler.start()'s background band evaluation "
           "(Autoscaler.step() is the same evaluation on demand)")

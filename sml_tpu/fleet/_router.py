@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import math
 import threading
+from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -144,6 +145,10 @@ class Router:
         # would dominate the routing hot path and pollute the ring, so
         # the value is cached for a short TTL
         self._burn = (0.0, float("-inf"))
+        # (t, burn) samples from each fresh burn_rate() compute — the
+        # burst-anticipating admission's slope window (docs/LOADGEN.md:
+        # tighten on the TREND toward breach, not the level after it)
+        self._burn_hist: deque = deque(maxlen=32)
 
     # ------------------------------------------------------------ signals
     def _class_index(self, priority: str) -> int:
@@ -179,7 +184,36 @@ class Router:
         value = float(obs.slo_report(window).get("burn_rate", 0.0))
         with self._lock:
             self._burn = (value, t + self.BURN_TTL_S)
+            self._burn_hist.append((t, value))
         return value
+
+    def _burn_slope(self) -> float:
+        """Least-squares slope (burn units per second) of the burn-rate
+        samples inside `sml.fleet.burstSlopeWindowSec` — the leading
+        edge of a burst shows up here while the windowed LEVEL still
+        averages it away."""
+        window = float(GLOBAL_CONF.get("sml.fleet.burstSlopeWindowSec"))
+        t = now()
+        with self._lock:
+            pts = [(ts, v) for ts, v in self._burn_hist
+                   if t - ts <= window]
+        if len(pts) < 2:
+            return 0.0
+        mean_t = sum(ts for ts, _ in pts) / len(pts)
+        mean_v = sum(v for _, v in pts) / len(pts)
+        num = sum((ts - mean_t) * (v - mean_v) for ts, v in pts)
+        den = sum((ts - mean_t) ** 2 for ts, _ in pts)
+        return (num / den) if den > 0 else 0.0
+
+    def _predicts_breach(self, burn: float) -> bool:
+        """Burst anticipation: does the current burn LEVEL plus its
+        SLOPE extrapolated over `sml.fleet.burstSlopeHorizonSec` cross
+        1.0? Horizon 0 disables the predictor entirely."""
+        horizon = float(GLOBAL_CONF.get("sml.fleet.burstSlopeHorizonSec"))
+        if horizon <= 0.0:
+            return False
+        slope = self._burn_slope()
+        return slope > 0.0 and burn + slope * horizon > 1.0
 
     def predicted_wait_ms(self, replica: Replica) -> float:
         """Audit-calibrated drain estimate for a replica's standing
@@ -200,8 +234,16 @@ class Router:
     def _class_fraction(self, idx: int) -> float:
         n = len(self._priorities)
         frac = (n - idx) / n
-        if idx > 0 and self.burn_rate() > 1.0:
-            frac *= 0.5
+        if idx > 0:
+            burn = self.burn_rate()
+            if burn > 1.0:
+                frac *= 0.5
+            elif self._predicts_breach(burn):
+                # the burn TREND says a burst will breach within the
+                # horizon: pre-tighten the non-top classes so the top
+                # class's headroom exists BEFORE the budget is spent
+                PROFILER.count("fleet.burst_tighten")
+                frac *= float(GLOBAL_CONF.get("sml.fleet.burstSlopeTighten"))
         return frac
 
     def take_occupancy(self) -> Optional[float]:

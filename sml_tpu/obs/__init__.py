@@ -219,6 +219,12 @@ def _fleet_report() -> Optional[Dict[str, object]]:
     return None if mod is None else mod.fleet_report()
 
 
+def _load_report() -> Optional[Dict[str, object]]:
+    import sys
+    mod = sys.modules.get("sml_tpu.loadgen")
+    return None if mod is None else mod.load_report()
+
+
 def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
     """ONE call, the engine's whole health surface: streaming-metric
     quantiles (serving latency, per-route dispatch walls), the dispatch
@@ -287,6 +293,11 @@ def engine_health(window_s: Optional[float] = None) -> Dict[str, object]:
         # receipts. Read lazily off sys.modules like infer_kernel —
         # None until a pool exists
         "fleet": _fleet_report(),
+        # open-loop load harness (sml_tpu/loadgen): the last completed
+        # replay's honest-tail report — per-phase/per-class p50/p99/
+        # p99.9, shed/timeout rates, overrun count, worst-request trace
+        # exemplars. Lazy like fleet — None until a replay ran
+        "load": _load_report(),
     }
     if RECORDER.enabled:
         RECORDER.emit("health", "health.snapshot", args={

@@ -97,6 +97,11 @@ COUNTERS = {
     # CAUSE, not one undifferentiated count
     "serve.shed.*",
     "serve.hot_swap",
+    # a caller's BOUNDED result(timeout=) wait expired before the batch
+    # resolved the future (serving/_batcher.py RequestTimeout): the
+    # future stays resolvable — this counts impatient callers, not
+    # dropped requests, distinct from serve.expired (deadline sheds)
+    "serve.timeout",
     "serve.model_cache_hit", "serve.model_cache_miss",
     "serve.model_cache_evict_bytes",
     "serve.canary_mirrored",
@@ -126,12 +131,21 @@ COUNTERS = {
     # fleet.autoscale_error (background steps that raised — the loop
     # survives, the failure is visible) / fleet.rollouts /
     # fleet.rollout_promotions / fleet.rollout_rollbacks (staged
-    # rollout outcomes)
+    # rollout outcomes) / fleet.burst_tighten (admission pre-tightened
+    # because the burn-rate SLOPE predicted an SLO breach within
+    # sml.fleet.burstSlopeHorizonSec — burst anticipation)
     "fleet.*",
     # registry stage-transition listeners that RAISED (the commit
     # landed; later listeners still fired): a dead subscriber must be
     # visible in the counters, like serve.canary_error
     "tracking.listener_error",
+    # open-loop trace-driven load harness (sml_tpu/loadgen): load.requests
+    # / load.served / load.shed / load.timeout / load.errors fired per
+    # scheduled request outcome, and load.overrun — requests the bounded
+    # worker pool fired LATER than their scheduled arrival instant (the
+    # schedule outran the pool; never silent, the committed gate requires
+    # zero)
+    "load.*",
     # graftlint gate receipts (bench.py --lint): lint.runs /
     # lint.violations (unsuppressed — 0 on any recorded run, the gate
     # refuses otherwise) / lint.suppressed_pragma /
@@ -145,6 +159,10 @@ COUNTERS = {
 GAUGES = {
     "hbm.*",              # hbm.<pool>_bytes / hbm.total_bytes
     "serve.queue_rows",   # rows admitted but not yet dispatched
+    "serve.flush_micros",  # the micro-batcher's LIVE flush deadline —
+                          # conf-static unless sml.serve.flushAutoTune
+                          # adapts it between the audit's predicted
+                          # drain and the SLO budget
     "slo.*",              # slo.burn_rate: breach fraction vs the
                           # sml.serve.sloMillis error budget, stamped by
                           # obs.engine_health()
@@ -211,12 +229,20 @@ EVENTS = {
     # verdict during a staged rollout) / fleet.rollout (the rollout's
     # final promote/rollback verdict)
     "fleet.*",
+    # open-loop load harness (sml_tpu/loadgen): load.phase (the replay
+    # driver crossing a trace-phase boundary) / load.run (one driver
+    # run's outcome receipt: requests, overruns, per-phase counts)
+    "load.*",
 }
 
 # streaming-metrics histograms (obs/_metrics.py METRICS.observe): latency
 # and size distributions kept as log-bucketed counts, NOT recorder events
 METRICS_NAMES = {
     "serve.request_ms",   # micro-batcher admission -> result per request
+    "serve.batch_ms",     # one flush's launch+drain wall at the flush
+                          # site — the drain floor the flush auto-tuner
+                          # reads (sml.serve.flushAutoTune), exemplar =
+                          # the flush's fan-in trace id
     "serve.canary_abs_diff",  # per mirrored request: max |shadow -
                           # primary| prediction divergence, exemplar =
                           # the request's trace id — canary_stats()
@@ -225,6 +251,12 @@ METRICS_NAMES = {
     "dispatch.*",         # dispatch.host_ms / dispatch.device_ms: measured
                           # walls of routed programs (fed by the audit's
                           # attach path)
+    "load.*",             # open-loop harness latencies, SCHEDULED-arrival
+                          # -> result (queueing charged to the system, not
+                          # hidden in the client): load.request_ms plus the
+                          # per-phase load.request_ms.<phase> and
+                          # per-phase/class load.request_ms.<phase>.<class>
+                          # families, exemplar = the request's trace id
 }
 
 _BY_KIND = {"span": SPANS, "count": COUNTERS, "counter": COUNTERS,

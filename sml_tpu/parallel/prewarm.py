@@ -337,6 +337,66 @@ def prewarm(workers: Optional[int] = None) -> dict:
     return stats
 
 
+def speculative_prewarm(fn: Callable, shapes: List[tuple],
+                        workers: Optional[int] = None) -> dict:
+    """Shape-bucket prewarm keyed off a DECLARED width mix instead of a
+    recorded manifest: first-dispatch `fn` on zero-filled operands of
+    each distinct shape from a thread pool, so a load trace's fat-tail
+    widths (docs/LOADGEN.md) hit warm per-bucket programs instead of
+    paying trace+dispatch inside the measured phases. `fn` takes one
+    array; shapes are (rows, features) tuples, deduplicated. Failures
+    are counted, never raised — speculation must not wedge a start-up.
+
+    Returns {programs, warmed, failed, wall_s, serial_s} like
+    `prewarm()`."""
+    import numpy as np
+    todo = sorted({tuple(int(d) for d in s) for s in shapes})
+    stats = {"programs": len(todo), "warmed": 0, "failed": 0,
+             "wall_s": 0.0, "serial_s": 0.0}
+    if not todo:
+        return stats
+    if workers is None:
+        workers = GLOBAL_CONF.getInt("sml.prewarm.workers")
+    workers = max(1, int(workers))
+    PROFILER.count("prewarm.speculative", float(len(todo)))
+    stats_lock = threading.Lock()
+
+    def _warm_one(shape: tuple) -> None:
+        t0 = _now()
+        ok = True
+        ctx = _trace.new_trace()
+        try:
+            with _trace.activate(ctx), \
+                    _WATCHDOG.watch("prewarm", "prewarm.speculative",
+                                    trace=ctx):
+                fn(np.zeros(shape, dtype=np.float32))
+        except Exception:
+            ok = False
+        dt = _now() - t0
+        with stats_lock:
+            stats["warmed" if ok else "failed"] += 1
+            stats["serial_s"] += dt
+        if not ok:
+            PROFILER.count("prewarm.failed")
+        if _OBS.enabled:
+            args = {"shape": list(shape), "ok": ok,
+                    "seconds": round(dt, 4)}
+            if ctx is not None:
+                args["trace"] = ctx.trace_id
+            _OBS.emit("prewarm", "prewarm.speculative", args=args)
+
+    t0 = _now()
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="sml-spec-prewarm") as pool:
+        for f in [pool.submit(_warm_one, s) for s in todo]:
+            f.result()
+    stats["wall_s"] = _now() - t0
+    if _OBS.enabled:
+        _OBS.emit("prewarm", "prewarm.speculative_done", args=dict(stats))
+    return stats
+
+
 def maybe_prewarm(block: bool = False) -> Optional[object]:
     """The opt-in replica-start hook (bench warmup, serving endpoint /
     fleet replica load): replay the manifest once per (manifest, mesh)

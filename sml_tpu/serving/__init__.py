@@ -51,6 +51,14 @@ _register("sml.serve.flushMicros", 2000, int,
           "Serving micro-batcher: microseconds a partial batch waits for "
           "more requests before flushing (deadline from the OLDEST queued "
           "request). 0 = flush as soon as the worker is free")
+_register("sml.serve.flushAutoTune", False, _to_bool,
+          "Serving micro-batcher deadline auto-tuning (tail engineering "
+          "for the open-loop load harness, docs/LOADGEN.md): adapt the "
+          "flush deadline each cycle between the audit's predicted drain "
+          "time (median measured dispatch.device_ms — the floor) and the "
+          "SLO budget (half sml.serve.sloMillis minus the drain — the "
+          "ceiling), targeting the time the MEASURED arrival intensity "
+          "needs to fill one batch. Off = flushMicros is static")
 _register("sml.serve.queueRows", 32768, int,
           "Serving admission bound: rows queued-or-in-flight toward the "
           "device (parallel.dispatch.DEVICE_QUEUE) above which new "
@@ -80,9 +88,10 @@ _register("sml.serve.canaryFraction", 0.0, float,
           "route off the request path and feed prediction-divergence "
           "stats (ServingEndpoint.canary_stats). 0 disables")
 
-from ._batcher import MicroBatcher, RequestShed, ScoreFuture  # noqa: E402
+from ._batcher import (MicroBatcher, RequestShed, RequestTimeout,  # noqa: E402
+                       ScoreFuture)
 from ._cache import MODEL_CACHE, ModelCache  # noqa: E402
 from ._endpoint import ServingEndpoint  # noqa: E402
 
-__all__ = ["MicroBatcher", "RequestShed", "ScoreFuture",
+__all__ = ["MicroBatcher", "RequestShed", "RequestTimeout", "ScoreFuture",
            "ModelCache", "MODEL_CACHE", "ServingEndpoint"]

@@ -49,6 +49,16 @@ class RequestShed(RuntimeError):
     the request's deadline passed before its batch flushed."""
 
 
+class RequestTimeout(TimeoutError):
+    """A caller's BOUNDED `result(timeout=)` wait expired before the
+    batch resolved the future. The future itself stays resolvable — the
+    in-flight batch still completes it, and a later `result()` returns
+    normally; only the caller's wait was bounded (the open-loop load
+    driver's contract: a timed-out request is counted `serve.timeout`,
+    never a hung worker and never a silently dropped request). Subclasses
+    `TimeoutError` so existing bounded-wait callers keep working."""
+
+
 class ScoreFuture:
     """Handle for one submitted request: `result()` blocks for the
     per-request prediction slice (or raises what the batch raised).
@@ -68,7 +78,10 @@ class ScoreFuture:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._event.wait(timeout):
-            raise TimeoutError("serving request still queued/in flight")
+            PROFILER.count("serve.timeout")
+            raise RequestTimeout(
+                "serving request still queued/in flight after the "
+                "caller's bounded wait (the future remains resolvable)")
         # snapshot: the flush worker writes `_error`/`_value` before
         # `_event.set()`, but a second setter (close() draining a queue
         # the worker is still flushing) may rebind between our check and
@@ -129,6 +142,7 @@ class MicroBatcher:
                  queue_rows: Optional[int] = None,
                  timeout_millis: Optional[int] = None,
                  host_fallback: Optional[bool] = None,
+                 flush_auto: Optional[bool] = None,
                  observer: Optional[Callable] = None,
                  queue: Optional[dispatch.QueuePressure] = None,
                  start: bool = True):
@@ -148,6 +162,12 @@ class MicroBatcher:
         micros = (conf.getInt("sml.serve.flushMicros")
                   if flush_micros is None else flush_micros)
         self._flush_s = max(int(micros), 0) / 1e6
+        self._flush_auto = (conf.getBool("sml.serve.flushAutoTune")
+                            if flush_auto is None else bool(flush_auto))
+        # measured arrival intensity for the deadline auto-tuner:
+        # (t, rows) admission marks, appended under the condition lock
+        # the flush worker reads them with
+        self._arrivals: deque = deque(maxlen=512)
         self.queue_rows = max(int(
             conf.getInt("sml.serve.queueRows")
             if queue_rows is None else queue_rows), 1)
@@ -206,6 +226,8 @@ class MicroBatcher:
         deadline = (now() + self._timeout_s) if self._timeout_s else None
         pending = _Pending(X, deadline)
         with self._cond:
+            if self._flush_auto:
+                self._arrivals.append((pending.t_enqueue, n))
             closed = self._closed
             saturated = closed or \
                 self._queue.rows() + n > self.queue_rows
@@ -254,6 +276,65 @@ class MicroBatcher:
         with self._cond:
             return self._queued_rows
 
+    @property
+    def flush_micros(self) -> int:
+        """The LIVE flush deadline (µs): the conf/ctor value unless
+        `sml.serve.flushAutoTune` is adapting it."""
+        return int(self._flush_s * 1e6)
+
+    #: auto-tune EWMA step: fraction of each adjustment applied at once
+    TUNE_ALPHA = 0.5
+    #: fraction of the SLO target the flush wait may consume (the rest
+    #: is headroom for the drain itself plus queueing jitter)
+    TUNE_SLO_SLACK = 0.5
+    #: trailing window the arrival-intensity estimate averages over
+    TUNE_WINDOW_S = 2.0
+
+    def _autotune(self) -> None:
+        """`sml.serve.flushAutoTune`: adapt the flush deadline between
+        the measured drain time and the SLO budget, under the MEASURED
+        arrival intensity. Floor — the median flush wall this batcher
+        tier actually paid (`serve.batch_ms`, observed at the flush
+        site; before the first flush lands, the dispatch audit's
+        routed-program walls stand in): flushing
+        faster than the device drains only queues batches behind the
+        tunnel. Ceiling — TUNE_SLO_SLACK of `sml.serve.sloMillis` minus
+        the drain: a deadline past that spends the request's whole error
+        budget waiting for batch mates. Between the bounds the target is
+        the time the measured arrival intensity needs to FILL one batch:
+        intense traffic flushes on rows before any deadline, and sparse
+        traffic stops holding lone requests to a window tuned for a load
+        that is not arriving — the mis-tuned-flushMicros trap the
+        open-loop load harness (sml_tpu/loadgen) exposes."""
+        hist = _METRICS.histogram("serve.batch_ms")
+        if hist is None:
+            # no flush has landed through this process's batchers yet:
+            # the audit's routed-program walls (fed by offline
+            # fit/predict dispatches) are the best available stand-in
+            hist = _METRICS.histogram("dispatch.device_ms")
+        if hist is None:
+            hist = _METRICS.histogram("dispatch.host_ms")
+        if hist is None:
+            return
+        drain_ms = float(hist.quantile(0.5))
+        if drain_ms <= 0.0:
+            return
+        slo_ms = float(GLOBAL_CONF.getInt("sml.serve.sloMillis"))
+        ceil_ms = max(slo_ms * self.TUNE_SLO_SLACK - drain_ms, drain_ms)
+        t = now()
+        with self._cond:
+            rows = sum(r for ts, r in self._arrivals
+                       if t - ts <= self.TUNE_WINDOW_S)
+        rate = rows / self.TUNE_WINDOW_S
+        fill_ms = (self.max_batch_rows / rate * 1e3) if rate > 0 \
+            else ceil_ms
+        target_ms = min(max(fill_ms, drain_ms), ceil_ms)
+        flush_ms = self._flush_s * 1e3
+        flush_ms += self.TUNE_ALPHA * (target_ms - flush_ms)
+        self._flush_s = flush_ms / 1e3
+        if _OBS.enabled:
+            _OBS.gauge("serve.flush_micros", round(flush_ms * 1e3, 1))
+
     def _rows_for_width(self, width: int) -> int:
         return sum(p.n for p in self._q if p.X.shape[1] == width)
 
@@ -290,6 +371,8 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while True:
+            if self._flush_auto:
+                self._autotune()
             with self._cond:
                 while not self._q and not self._closed:
                     self._cond.wait(0.05)
@@ -340,11 +423,21 @@ class MicroBatcher:
             "parent_spans": _trace.parent_ids(parents)}
         ticket = _WATCHDOG.open("serve.flush", "serve.batch", trace=bctx)
         try:
+            t_flush = now()
             with _trace.activate(bctx):
                 with PROFILER.span("serve.batch", rows=total,
                                    requests=len(live), **fan_meta):
                     out = np.asarray(self._score_block(X),
                                      dtype=np.float64)
+            # one flush's launch+drain wall, measured at the flush site —
+            # route-agnostic (whatever route score_block took, this is
+            # what one flush costs THIS serving path). The histogram is
+            # the drain floor `_autotune` reads: the audit's
+            # `dispatch.*_ms` walls only exist where a route-tagged
+            # program span ran, which the online path doesn't guarantee
+            _METRICS.observe("serve.batch_ms", (now() - t_flush) * 1e3,
+                             exemplar=None if bctx is None
+                             else bctx.trace_id)
             PROFILER.count("serve.batches")
             # rows that actually entered a device batch — the occupancy
             # numerator (serve.rows also counts shed/host-routed admissions)
