@@ -960,13 +960,22 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
                 # collective-wait, rendered as per-device trace lanes
                 staged = tree_impl.stage_tree_data(
                     X, y, max_bins=MULTICHIP_BINS)
-                blocks = meshlib.addressable_row_blocks(staged.binned_dev)
+                # group-aware iteration (host_row_blocks, not the flat
+                # addressable list): on a hierarchical mesh each probe
+                # carries its device's host-group id, so the timings
+                # feed the per-HOST skew lanes next to the per-chip
+                # ones; on a flat mesh every device is group 0 and the
+                # host roll-up degenerates harmlessly
+                blocks = [(g, dev, blk)
+                          for g, devblks in meshlib.host_row_blocks(
+                              staged.binned_dev, mesh)
+                          for dev, blk in devblks]
                 # graftlint: disable=dispatch-bypass -- skew probe: must time ONE chip's shard in isolation, untouched by routing or the mesh (a dispatched program would re-shard the block)
                 probe_fn = jax.jit(
                     lambda b: (b.astype(jnp.float32) ** 2).sum(axis=0))
-                jax.block_until_ready(probe_fn(blocks[0][1]))  # compile
+                jax.block_until_ready(probe_fn(blocks[0][2]))  # compile
                 shard_walls = []
-                for _dev, blk in blocks:
+                for _g, _dev, blk in blocks:
                     bw = float("inf")
                     for _ in range(3):
                         t0 = time.perf_counter()
@@ -975,7 +984,8 @@ def run_multichip(rows: int = MULTICHIP_ROWS) -> dict:
                     shard_walls.append(bw)
                 attr = obs.SKEW.note(
                     f"multichip_{w}dev", shard_walls,
-                    devices=[d.id for d, _ in blocks], wall_s=best,
+                    devices=[d.id for _, d, _ in blocks],
+                    hosts=[g for g, _, _ in blocks], wall_s=best,
                     psum_bytes=coll.get("collective.psum_bytes", 0.0),
                     psum_launches=coll.get("collective.psum", 0.0))
                 straggler = obs.straggler_report()
@@ -1047,6 +1057,207 @@ def multichip_main(rows: int) -> None:
         "parity_ok": all(e["parity_vs_1"] for e in block["widths"]),
         "straggler_device": straggler.get("slowest_device"),
         "skew_ratio": straggler.get("skew_ratio"),
+        "legs_file": "bench_legs.json",
+    }))
+
+
+# ------------------------------------------------------------ multihost leg
+MULTIHOST_ROWS = 100_000
+
+
+def run_multihost(rows: int = MULTIHOST_ROWS) -> dict:
+    """`--multihost`: the DCN-aware hierarchical-collective leg (ISSUE
+    20) — the same boosted fit executed on 1..H virtual-host meshes
+    (`parallel.mesh.host_mesh`: the 8-device sim partitioned into host
+    groups, `jax.process_index()` slices on a real pod), with every
+    histogram merge a two-level `psum_hierarchical` (intra-group
+    reduce-scatter over "ici", inter-group allreduce over "dcn",
+    allgather back) instead of one flat allreduce.
+
+    Per host-group shape the leg records: best-of-3 warm fit seconds
+    and rows/s, the PER-HOP collective launch/byte statics
+    (`collective.psum.ici/.dcn`, `collective.psum_bytes.ici/.dcn` —
+    trace-time counts, like the multichip block), the DCN byte fraction
+    vs the flat-mesh allreduce payload (the whole point: the cross-host
+    hop must carry ~payload/ici_size, not the full payload), model
+    parity vs the 1-host-group fit (layout-invariant sampling), and a
+    per-HOST skew table from group-aware per-shard compute probes
+    (obs/_skew.py host lanes). Merges into the bench sidecar as the
+    `multihost` block; obs/regress.py judges DCN-byte growth, lost
+    parity, and a vanished skew table as regressions."""
+    import jax
+    import jax.numpy as jnp
+
+    from sml_tpu import obs
+    from sml_tpu.conf import GLOBAL_CONF
+    from sml_tpu.ml import tree_impl
+    from sml_tpu.ml._tree_models import _fit_ensemble
+    from sml_tpu.parallel import mesh as meshlib
+
+    n_avail = len(jax.devices())
+    shapes = [h for h in (1, 2, 4, 8, 16)
+              if h <= n_avail and n_avail % h == 0]
+    rng = np.random.default_rng(42)
+    F = 10
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    y = (X[:, 0] * 3 - X[:, 1] ** 2 + 0.5 * X[:, 2]
+         + rng.normal(0, 0.3, rows)).astype(np.float32)
+    probe = X[:4096]
+
+    def fit():
+        return _fit_ensemble(
+            X, y, categorical={}, max_depth=MULTICHIP_DEPTH,
+            max_bins=MULTICHIP_BINS, min_instances=1, min_info_gain=0.0,
+            n_trees=MULTICHIP_TREES, feature_k=None, bootstrap=True,
+            subsample=1.0, seed=42, loss="squared")
+
+    prev_obs = GLOBAL_CONF.get("sml.obs.enabled")
+    GLOBAL_CONF.set("sml.obs.enabled", True)
+    entries = []
+    ref_pred = None
+    straggler = None
+    try:
+        # flat-mesh reference: the single-hop allreduce payload every
+        # DCN fraction below is judged against
+        with meshlib.use_mesh(meshlib.build_mesh(n_avail)):
+            obs.reset()
+            fit()
+            flat_bytes = float(obs.RECORDER.counters()
+                               .get("collective.psum_bytes", 0.0))
+        for h in shapes:
+            mesh = meshlib.host_mesh(h)
+            per = n_avail // h
+            with meshlib.use_mesh(mesh):
+                obs.reset()
+                spec = fit()  # warmup: compile + bin + stage + trace
+                coll = obs.RECORDER.counters()
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    fit()
+                    best = min(best, time.perf_counter() - t0)
+                pred = spec.predict_margin(probe)
+                # per-host straggler attribution: same per-shard compute
+                # probe as the multichip leg, iterated GROUP-AWARE so
+                # each timing carries its host id and the tracker's
+                # host lanes + slowest-host roll-up light up
+                staged = tree_impl.stage_tree_data(
+                    X, y, max_bins=MULTICHIP_BINS)
+                blocks = [(g, dev, blk)
+                          for g, devblks in meshlib.host_row_blocks(
+                              staged.binned_dev, mesh)
+                          for dev, blk in devblks]
+                # graftlint: disable=dispatch-bypass -- skew probe: must time ONE chip's shard in isolation, untouched by routing or the mesh (a dispatched program would re-shard the block)
+                probe_fn = jax.jit(
+                    lambda b: (b.astype(jnp.float32) ** 2).sum(axis=0))
+                jax.block_until_ready(probe_fn(blocks[0][2]))  # compile
+                shard_walls = []
+                for _g, _dev, blk in blocks:
+                    bw = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(probe_fn(blk))
+                        bw = min(bw, time.perf_counter() - t0)
+                    shard_walls.append(bw)
+                attr = obs.SKEW.note(
+                    f"multihost_{h}x{per}", shard_walls,
+                    devices=[d.id for _, d, _ in blocks],
+                    hosts=[g for g, _, _ in blocks], wall_s=best,
+                    psum_bytes=coll.get("collective.psum_bytes.dcn", 0.0),
+                    psum_launches=coll.get("collective.psum.dcn", 0.0))
+                straggler = obs.straggler_report()
+            if ref_pred is None:
+                ref_pred = pred
+            parity = bool(np.allclose(pred, ref_pred, rtol=1e-4, atol=1e-4))
+            dcn_b = float(coll.get("collective.psum_bytes.dcn", 0.0))
+            ici_b = float(coll.get("collective.psum_bytes.ici", 0.0))
+            # the acceptance bound: the cross-host hop may carry at most
+            # the inter-group fraction (payload / ici_size) of the flat
+            # allreduce's bytes — 1% slack covers padding-to-ici_size
+            dcn_ok = (dcn_b <= flat_bytes / per * 1.01 + 1024
+                      if dcn_b and flat_bytes else None)
+            host_skew = None
+            if attr is not None and attr.get("host_ids"):
+                host_skew = [{"host": int(g),
+                              "compute_ms": round(c * 1e3, 4)}
+                             for g, c in zip(attr["host_ids"],
+                                             attr["per_host_compute_s"])]
+            entries.append({
+                "hosts": h,
+                "per_host": per,
+                "seconds": round(best, 4),
+                "rows_per_s": round(rows / best, 1),
+                "speedup_vs_1": round(entries[0]["seconds"] / best, 3)
+                if entries else 1.0,
+                "psum_ici": int(coll.get("collective.psum.ici", 0)),
+                "psum_dcn": int(coll.get("collective.psum.dcn", 0)),
+                "psum_bytes_ici": ici_b,
+                "psum_bytes_dcn": dcn_b,
+                "all_gather_bytes_ici":
+                    float(coll.get("collective.all_gather_bytes.ici", 0.0)),
+                "dcn_fraction": round(dcn_b / flat_bytes, 4)
+                if dcn_b and flat_bytes else None,
+                "dcn_le_flat_fraction": dcn_ok,
+                "parity_ok": parity,
+                "slowest_host": None if attr is None
+                else attr.get("slowest_host"),
+                "host_skew": host_skew,
+            })
+            e = entries[-1]
+            print(f"  multihost {h}x{per}: {best:.3f}s "
+                  f"({rows / best:,.0f} rows/s, dcn "
+                  f"{dcn_b / 1e3:.2f} KB/trace "
+                  f"[{e['dcn_fraction'] if e['dcn_fraction'] is not None else '-'}"
+                  f" of flat], parity={parity}, "
+                  f"slowest_host={e['slowest_host']})", file=sys.stderr)
+    finally:
+        GLOBAL_CONF.set("sml.obs.enabled", bool(prev_obs))
+    return {
+        "rows": rows, "n_features": F, "n_trees": MULTICHIP_TREES,
+        "max_depth": MULTICHIP_DEPTH, "max_bins": MULTICHIP_BINS,
+        "backend": jax.default_backend(), "n_devices": n_avail,
+        "flat_psum_bytes": flat_bytes,
+        "note": "best-of-3 warm fits per host-group shape; per-hop "
+                "collective counters are per-TRACE statics; "
+                "dcn_fraction = the cross-host hop's psum bytes as a "
+                "fraction of the flat allreduce payload (bounded by "
+                "1/per_host — the hierarchical-allreduce win); "
+                "parity_ok = same model as the 1-host-group mesh "
+                "(layout-invariant sampling); host_skew = per-host "
+                "compute attribution from group-aware shard probes "
+                "(obs/_skew.py host lanes)",
+        "shapes": entries,
+        # aggregate straggler attribution for the LAST shape (obs.reset
+        # runs per shape): includes the host-level roll-up
+        "straggler": straggler,
+    }
+
+
+def multihost_main(rows: int) -> None:
+    """Run the multi-host leg standalone, merge the `multihost` block
+    into the bench sidecar, and print the short headline JSON last."""
+    block = run_multihost(rows)
+    doc = {}
+    if os.path.exists(LEGS_FILE):
+        with open(LEGS_FILE) as f:
+            doc = json.load(f)
+    doc["multihost"] = block
+    with open(LEGS_FILE, "w") as f:
+        json.dump(doc, f, indent=1)
+    fracs = [e["dcn_fraction"] for e in block["shapes"]
+             if e.get("dcn_fraction")]
+    straggler = block.get("straggler") or {}
+    print(json.dumps({
+        "metric": "multihost DCN-byte fraction (hierarchical vs flat)",
+        "value": min(fracs) if fracs else None,
+        "unit": "x of flat allreduce payload (cross-host hop)",
+        "n_devices": block["n_devices"],
+        "backend": block["backend"],
+        "parity_ok": all(e["parity_ok"] for e in block["shapes"]),
+        "dcn_bound_ok": all(e["dcn_le_flat_fraction"] in (True, None)
+                            for e in block["shapes"]),
+        "slowest_host": straggler.get("slowest_host"),
+        "host_skew_ratio": straggler.get("host_skew_ratio"),
         "legs_file": "bench_legs.json",
     }))
 
@@ -2890,8 +3101,9 @@ def main():
         try:
             with open(LEGS_FILE) as f:
                 prev_doc = json.load(f)
-            for block in ("multichip", "kernel", "kernel_infer", "scale",
-                          "drift", "lint", "ct", "fleet", "load"):
+            for block in ("multichip", "multihost", "kernel",
+                          "kernel_infer", "scale", "drift", "lint", "ct",
+                          "fleet", "load"):
                 if block in prev_doc and block not in sidecar:
                     sidecar[block] = prev_doc[block]
         except (OSError, ValueError):
@@ -3008,6 +3220,16 @@ if __name__ == "__main__":
                              "device_count=8)")
     parser.add_argument("--multichip-rows", type=int, default=MULTICHIP_ROWS,
                         help="row count for the --multichip leg")
+    parser.add_argument("--multihost", action="store_true",
+                        help="run ONLY the hierarchical DCN-aware "
+                             "collective leg over 1..H virtual-host "
+                             "meshes (host groups over the live device "
+                             "set) and merge the `multihost` block into "
+                             "the bench sidecar (simulate hosts on CPU "
+                             "with XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=8)")
+    parser.add_argument("--multihost-rows", type=int, default=MULTIHOST_ROWS,
+                        help="row count for the --multihost leg")
     parser.add_argument("--kernelbench", action="store_true",
                         help="run ONLY the fused-kernel sweep (maxBins × "
                              "maxDepth, sml.tree.kernel=pallas vs =xla, "
@@ -3109,6 +3331,8 @@ if __name__ == "__main__":
     entry = (pin_goldens if args.pin_goldens else
              (lambda: multichip_main(args.multichip_rows))
              if args.multichip else
+             (lambda: multihost_main(args.multihost_rows))
+             if args.multihost else
              (lambda: kernelbench_main(args.kernelbench_rows))
              if args.kernelbench else
              (lambda: drift_main(args.drift_rows))

@@ -65,6 +65,21 @@ _register("sml.tree.histSubtraction", True, _to_bool,
           "counts with the built-in integer sampling weights; fractional "
           "fit_tree weights and grad/hess sums pick up depth-compounding "
           "cancellation noise")
+_register("sml.tree.hierarchicalAllreduce", "auto", str,
+          "Two-level histogram allreduce on host-grouped meshes: "
+          "'auto' = intra-group reduce-scatter over the 'ici' hop + "
+          "inter-group allreduce over the 'dcn' hop + allgather back "
+          "whenever the active mesh declares a host axis "
+          "(mesh.host_mesh); 'true' = same, but error-prone on flat "
+          "meshes so it still requires the host axes; 'false' = always "
+          "the flat single-hop psum. Per-hop launches/bytes land in "
+          "collective.psum[_bytes].ici/.dcn (docs/PERF.md)")
+_register("sml.mesh.hostGroups", 0, int,
+          "Default host-group count for mesh.host_mesh() when called "
+          "without an explicit `hosts`: 0 = auto (jax.process_count() "
+          "on a real multi-host slice, else 1); N>0 = N virtual host "
+          "groups partitioning the flat device set (the single-machine "
+          "testing story for the multi-host code path)")
 _register("sml.tree.kernel", "auto", str,
           "Histogram-build + split-scan implementation for tree fits: "
           "'xla' = the one-hot dot + cumsum HLO chain (the pre-kernel "

@@ -45,7 +45,7 @@ Knob table and the promotion-gate ladder: docs/CONTINUOUS_TRAINING.md.
 
 from __future__ import annotations
 
-from ..conf import _register
+from ..conf import _register, _to_bool
 
 _register("sml.ct.warmSeverity", 1.0, float,
           "Drift severity (max live-vs-baseline distance as a multiple "
@@ -84,6 +84,18 @@ _register("sml.ct.gateQualityTol", 1.1, float,
           "window must be <= the incumbent's RMSE times this tolerance "
           "(a drift-triggered refit should WIN on drifted data; the "
           "tolerance admits ties on iid windows)")
+_register("sml.ct.elasticResume", True, _to_bool,
+          "Elastic multi-host fits: when a host group is preempted "
+          "mid-fit (ct.elastic_fit catches HostPreempted), rebuild the "
+          "host mesh over the surviving groups, re-partition the chunk "
+          "ranges, and resume from the newest round-level checkpoint. "
+          "Off = the preemption propagates to the orchestrator (every "
+          "resume still counts elastic.resume / elastic.repartition)")
+_register("sml.ct.elasticMaxRestarts", 3, int,
+          "Resume budget of one elastic_fit call: preemptions beyond "
+          "this many mesh rebuilds propagate instead of resuming (a "
+          "fleet losing hosts faster than it fits should fail loudly, "
+          "not shrink to a single group)")
 _register("sml.ct.gateRows", 2048, int,
           "Rows of the fresh window replayed through the endpoint as "
           "gate traffic (bounds the gate's scoring cost; also the "
@@ -92,9 +104,10 @@ _register("sml.ct.gateRows", 2048, int,
 from ._sources import DeltaChunkSource, StreamChunkSource  # noqa: E402
 from ._checkpoint import (BoostCheckpoint, checkpointed_fit,  # noqa: E402
                           checkpointed_warm_start)
+from ._elastic import HostPreempted, elastic_fit  # noqa: E402
 from ._gate import CanaryGate  # noqa: E402
 from ._trainer import ContinuousTrainer  # noqa: E402
 
 __all__ = ["StreamChunkSource", "DeltaChunkSource", "BoostCheckpoint",
            "checkpointed_fit", "checkpointed_warm_start", "CanaryGate",
-           "ContinuousTrainer"]
+           "ContinuousTrainer", "HostPreempted", "elastic_fit"]

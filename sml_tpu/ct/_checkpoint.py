@@ -179,7 +179,8 @@ def checkpointed_fit(source, checkpoint_dir: str, *, n_trees: int,
                      min_instances: int = 1, min_info_gain: float = 0.0,
                      reg_lambda: float = 0.0, gamma: float = 0.0,
                      rounds_per_dispatch: Optional[int] = None,
-                     drift_baseline=None, sketch=None):
+                     drift_baseline=None, sketch=None,
+                     on_checkpoint=None):
     """A chunked boosting fit that survives interruption: every dispatch
     boundary checkpoints the partial ensemble (pass `rounds_per_dispatch`
     to set the boundary spacing — one monolithic dispatch has no
@@ -192,7 +193,11 @@ def checkpointed_fit(source, checkpoint_dir: str, *, n_trees: int,
     cleared on success). Restartability contract: the resumed model is
     bit-identical to the uninterrupted fit of the same (source, params,
     seed). `sketch` — a caller-provided pass-1 sketch of the same
-    window — saves one streaming pass (see `ingest_source`)."""
+    window — saves one streaming pass (see `ingest_source`).
+    `on_checkpoint(t_done)` fires after each checkpoint COMMITS (the
+    LATEST pointer is already durable) — the chaos-injection point
+    elastic fits use to simulate a preemption at a known boundary; an
+    exception it raises aborts the fit but never the checkpoint."""
     from ..ml._chunked import ingest_source, warm_start_ensemble_chunked
     from ..ml._tree_models import _fit_ensemble
 
@@ -224,6 +229,8 @@ def checkpointed_fit(source, checkpoint_dir: str, *, n_trees: int,
                 float(saved["step_size"]), partial.depth, partial.binning,
                 base, partial.n_features, partial.mode)
             ck.save(snap, t_done, saved)
+            if on_checkpoint is not None:
+                on_checkpoint(int(t_done))
 
         spec = warm_start_ensemble_chunked(
             partial, source, n_new_trees=remaining,
@@ -247,6 +254,8 @@ def checkpointed_fit(source, checkpoint_dir: str, *, n_trees: int,
         snap = _snapshot_spec(trees_so_far, step_size, max_depth,
                               ing.binning, base, source.n_features, mode)
         ck.save(snap, t_done, meta)
+        if on_checkpoint is not None:
+            on_checkpoint(int(t_done))
 
     spec = _fit_ensemble(
         None, ing.y, categorical=categorical, max_depth=max_depth,

@@ -1,0 +1,124 @@
+"""Elastic preemption-tolerant multi-host fits.
+
+A multi-host fit on preemptible capacity loses whole HOST GROUPS, not
+single rounds: the mesh shrinks, the per-host chunk partition
+(`parallel.mesh.host_partition`) no longer matches, and everything
+staged on the dead group's devices is gone. `elastic_fit` makes that an
+inconvenience instead of a restart-from-zero:
+
+- the fit runs as a `checkpointed_fit` on a hierarchical host mesh
+  (`parallel.mesh.host_mesh`), so every dispatch boundary has a durable
+  round-level checkpoint (`BoostCheckpoint` — the PR-13 restartability
+  contract);
+- a `HostPreempted` raised mid-fit (a real preemption notice, or the
+  chaos hook's simulated kill at a checkpoint boundary) is caught, the
+  mesh is REBUILT over the surviving groups, the chunk ranges
+  re-partition to the new group count, and the SAME `checkpointed_fit`
+  call resumes from the newest checkpoint — it costs the rounds since
+  the last dispatch boundary plus one re-ingest, never the fit;
+- every resume is visible: `elastic.resume` / `elastic.repartition`
+  counters plus an `elastic.resume` event carrying the old/new group
+  counts and the rows whose host assignment moved.
+
+Sampling is layout-invariant (PR 6) and the margin replay carry-exact
+(PR 13), so the resumed model matches the uninterrupted fit up to float
+reduction-order across the mesh resize — bit-identical when the mesh
+shape survives the preemption (a replacement group joins).
+
+Gate: `sml.ct.elasticResume` (off → `HostPreempted` propagates, the
+orchestrator's problem); restart budget: `sml.ct.elasticMaxRestarts`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..conf import GLOBAL_CONF
+from ..obs._recorder import RECORDER as _OBS
+from ..parallel import mesh as meshlib
+from ._checkpoint import checkpointed_fit
+
+
+class HostPreempted(RuntimeError):
+    """One host group died mid-fit. `group` is the dead group's index in
+    the CURRENT mesh (None when unknown — still triggers a resume, the
+    surviving count just defaults to one fewer)."""
+
+    def __init__(self, msg: str = "host group preempted",
+                 group: Optional[int] = None):
+        super().__init__(msg)
+        self.group = group
+
+
+def moved_rows(n_rows: int, old_hosts: int, new_hosts: int) -> int:
+    """Rows whose host-group assignment changes when the contiguous
+    `host_partition` re-splits from `old_hosts` to `new_hosts` groups —
+    the re-ingest traffic a resume pays (group g keeps the overlap of
+    its old and new range; everything else moves)."""
+    old = meshlib.host_partition(n_rows, old_hosts)
+    new = meshlib.host_partition(n_rows, new_hosts)
+    kept = 0
+    for g in range(min(len(old), len(new))):
+        (a0, a1), (b0, b1) = old[g], new[g]
+        kept += max(0, min(a1, b1) - max(a0, b0))
+    return max(0, int(n_rows)) - kept
+
+
+def _surviving_mesh(mesh, dead_group: Optional[int]):
+    """The host mesh over the groups that outlive a preemption: same
+    devices-per-group, the dead group's row dropped (the LAST group when
+    the notice named none). Raises when no group survives."""
+    groups = int(mesh.shape[meshlib.DCN_AXIS])
+    per = int(mesh.shape[meshlib.ICI_AXIS])
+    if groups <= 1:
+        raise HostPreempted("last host group preempted — nothing to "
+                            "resume on", group=dead_group)
+    rows = mesh.devices.reshape(groups, per)
+    dead = groups - 1 if dead_group is None else int(dead_group) % groups
+    import numpy as np
+    survivors = np.concatenate([rows[:dead], rows[dead + 1:]])
+    base = meshlib.Mesh(survivors.reshape(-1), (meshlib.DATA_AXIS,))
+    return meshlib.host_mesh(groups - 1, per, mesh=base)
+
+
+def elastic_fit(source, checkpoint_dir: str, *, hosts: Optional[int] = None,
+                devices_per_host: Optional[int] = None,
+                on_checkpoint=None, **fit_params):
+    """A `checkpointed_fit` on a host-grouped mesh that survives losing
+    host groups: on `HostPreempted` (raised by a preemption notice or
+    the `on_checkpoint` chaos hook) the mesh rebuilds over the
+    survivors, chunks re-partition, and the fit resumes from the newest
+    checkpoint. Returns the finished `_EnsembleSpec`, exactly like
+    `checkpointed_fit`; all its keyword parameters pass through.
+
+    `on_checkpoint(t_done)` fires after each checkpoint commits — tests
+    raise `HostPreempted` from it to kill a group at a known round
+    boundary. With `sml.ct.elasticResume` off, or past
+    `sml.ct.elasticMaxRestarts` resumes, the preemption propagates."""
+    mesh = meshlib.host_mesh(hosts, devices_per_host)
+    max_restarts = int(GLOBAL_CONF.get("sml.ct.elasticMaxRestarts") or 0)
+    restarts = 0
+    while True:
+        try:
+            with meshlib.use_mesh(mesh):
+                return checkpointed_fit(source, checkpoint_dir,
+                                        on_checkpoint=on_checkpoint,
+                                        **fit_params)
+        except HostPreempted as e:
+            if (not GLOBAL_CONF.getBool("sml.ct.elasticResume")
+                    or restarts >= max_restarts):
+                raise
+            restarts += 1
+            old_groups = int(mesh.shape[meshlib.DCN_AXIS])
+            mesh = _surviving_mesh(mesh, e.group)
+            new_groups = int(mesh.shape[meshlib.DCN_AXIS])
+            n_rows = getattr(source, "n_rows", None)
+            moved = (moved_rows(n_rows, old_groups, new_groups)
+                     if n_rows else None)
+            if _OBS.enabled:
+                _OBS.counter("elastic.resume")
+                _OBS.counter("elastic.repartition")
+                _OBS.emit("elastic", "elastic.resume", args={
+                    "from_hosts": old_groups, "to_hosts": new_groups,
+                    "dead_group": e.group, "moved_rows": moved,
+                    "restart": restarts})

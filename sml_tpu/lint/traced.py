@@ -92,8 +92,13 @@ from .rules.dispatch_bypass import ALLOWLIST, _is_jax_jit_expr
 COLLECTIVE_OPS = frozenset({
     "psum", "psum_scalars", "pmean", "pmax", "pmin", "all_gather",
     "reduce_scatter", "psum_scatter", "all_to_all", "ppermute",
-    "axis_index", "masked_count",
+    "axis_index", "masked_count", "psum_hierarchical",
 })
+
+#: the TWO-HOP collective: each hop names its own sub-axis via a
+#: dedicated kwarg, so discipline is checked per hop (a typo'd
+#: `ici_axis=`/`dcn_axis=` must flag even when the other hop is right)
+HIERARCHICAL_OPS = {"psum_hierarchical": ("ici_axis", "dcn_axis")}
 
 #: callee simple name -> does it SHARD-map its first argument?
 #: (vmap traces but adds no mesh axis; jit/pallas seeds are handled by
@@ -795,13 +800,30 @@ class _Analyzer:
                    tests: List[ast.expr]) -> None:
         name = call_target_name(node.func)
         if name in COLLECTIVE_OPS:
-            axis, kind = self._site_axis(node, rel, scope)
             divergent = None
             if fn is not None:
                 for t in tests:
                     divergent = self._taint_reason(t, fn, tainted)
                     if divergent is not None:
                         break
+            if name in HIERARCHICAL_OPS:
+                # one discipline site PER HOP kwarg: both hop axes must
+                # independently resolve to declared sub-axes; omitted
+                # kwargs ride the wrapper defaults (kind "default")
+                hops = [kw for kw in node.keywords
+                        if kw.arg in HIERARCHICAL_OPS[name]]
+                if not hops:
+                    self.out.collectives.append(CollectiveSite(
+                        rel, node.lineno, name, None, "default", fn_key,
+                        fn_name, divergent))
+                for kw in hops:
+                    axis = self._axis_of(kw.value, rel, scope)
+                    self.out.collectives.append(CollectiveSite(
+                        rel, node.lineno, name, axis,
+                        "literal" if axis is not None else "dynamic",
+                        fn_key, fn_name, divergent))
+                return
+            axis, kind = self._site_axis(node, rel, scope)
             self.out.collectives.append(CollectiveSite(
                 rel, node.lineno, name, axis, kind, fn_key, fn_name,
                 divergent))

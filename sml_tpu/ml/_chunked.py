@@ -161,7 +161,7 @@ def ingest_source(source: ChunkSource, max_bins: int,
     from ._staging import (_chunk_assemble_program, insert_bins_cached,
                            transient_hbm)
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     n_padded = meshlib.bucket_rows(n, n_dev)
     F = source.n_features
     C = min(max(int(source.chunk_rows), 1), n_padded)

@@ -231,7 +231,7 @@ def _bin_cache_budget() -> int:
 
 def _bin_cache_key(a: np.ndarray, mesh) -> tuple:
     return (_memo_key(a), id(mesh), "bins",
-            mesh.shape[meshlib.DATA_AXIS])
+            meshlib.data_width(mesh))
 
 
 def _bin_cache_touch(key):
@@ -281,7 +281,7 @@ def stage_bins_cached(binned: np.ndarray) -> jax.Array:
     on the same padded shape."""
     from ..utils.profiler import PROFILER
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     a = _normalize(binned)
     key = _bin_cache_key(a, mesh)
     hit = _bin_cache_touch(key)
@@ -389,7 +389,7 @@ def stage_rows_cached(a: np.ndarray, pad_to_multiple: bool = True) -> jax.Array:
     """device_put a row-sharded array through the content cache."""
     from ..utils.profiler import PROFILER
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     a = _normalize(a)
     key = (_memo_key(a), id(mesh), "arr", n_dev)
     hit = _stage_cache.get(key)
@@ -414,13 +414,14 @@ def stage_stacked_cached(a: np.ndarray) -> jax.Array:
     the mesh's data dimension. Used by the batched fold×param tree fits."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     a = _normalize(a)
     key = (_memo_key(a), id(mesh), "stack", n_dev)
     hit = _stage_cache.get(key)
     from ..utils.profiler import PROFILER
     if hit is None:
-        spec = P(None, meshlib.DATA_AXIS, *([None] * (a.ndim - 2)))
+        spec = P(None, meshlib.row_spec_entry(mesh),
+                 *([None] * (a.ndim - 2)))
         hit = jax.device_put(a, NamedSharding(mesh, spec))
         _cache_put(key, hit)
         PROFILER.count("staging.cache_miss")
@@ -459,7 +460,8 @@ def stage_trial_stacked_cached(a: np.ndarray, mesh) -> jax.Array:
 
 def stage_mask_cached(n_padded: int, n_true: int) -> jax.Array:
     mesh = meshlib.get_mesh()
-    mkey = (n_padded, n_true, id(mesh), "mask", mesh.shape[meshlib.DATA_AXIS])
+    mkey = (n_padded, n_true, id(mesh), "mask",
+            meshlib.data_width(mesh))
     hit = _stage_cache.get(mkey)
     if hit is None:
         hit = meshlib.row_mask(n_padded, n_true)
@@ -503,7 +505,7 @@ def _route_mesh(hint, arrays, may_promote: bool = True,
         dispatch.audit_decision(resident, "host")
         return dispatch.host_mesh(), "host"
     dev_mesh = meshlib.get_mesh()
-    n_dev = dev_mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(dev_mesh)
     eff = hint
     keyed = []
     kind = "stack" if stacked else "arr"
@@ -617,12 +619,13 @@ def data_parallel(fn: Callable, *, out_replicated: bool = True,
     shard_map+jit in `tree_impl._compiled_chunk`.
     """
     mesh = meshlib.get_mesh()
-    out_spec = P() if out_replicated else P(meshlib.DATA_AXIS)
+    out_spec = P() if out_replicated else P(meshlib.row_spec_entry(mesh))
 
     def spec_for(i, x):
         if i in replicated_argnums:
             return P()
-        return P(*([meshlib.DATA_AXIS] + [None] * (np.ndim(x) - 1)))
+        return P(*([meshlib.row_spec_entry(mesh)]
+                   + [None] * (np.ndim(x) - 1)))
 
     def wrapped(*args):
         specs = tuple(spec_for(i, a) for i, a in enumerate(args))

@@ -477,7 +477,7 @@ def _fit_ensembles_grid(Xs, ys, cats, trials, max_fused: int,
     B = max(t["max_bins"] for t in trials)
     T = max(t["n_trees"] for t in trials)
     mesh = _meshlib.get_mesh()
-    n_dev = mesh.shape[_meshlib.DATA_AXIS]
+    n_dev = _meshlib.data_width(mesh)
     n_pad = max(_meshlib.bucket_rows(b.shape[0], n_dev)
                 for b in binned.values())
     stack_dtype = np.result_type(*[b.dtype for b in binned.values()])

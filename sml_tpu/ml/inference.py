@@ -127,7 +127,7 @@ def infer_spec_key(n_trees: int, depth: int, n_feat: int, n_bins: int,
     program actually compiles for, so near-size batches share one tuned
     spec exactly as they share one executable."""
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     return {"trees": int(n_trees), "depth": int(depth),
             "features": int(n_feat), "bins": int(n_bins),
             "rows": int(meshlib.bucket_rows(n_rows, n_dev))}

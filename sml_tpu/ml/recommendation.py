@@ -153,7 +153,7 @@ def stage_als_sorted(prep: dict, n_users: int, n_items: int):
     from ._staging import stage_rows_cached
 
     mesh = meshlib.get_mesh()
-    n_dev = mesh.shape[meshlib.DATA_AXIS]
+    n_dev = meshlib.data_width(mesh)
     n = len(prep["rat_u"])
     n_padded = meshlib.bucket_rows(n, n_dev)
     blk = n_padded // n_dev

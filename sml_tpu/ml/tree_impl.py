@@ -378,9 +378,28 @@ def _hist_subtract() -> bool:
     return GLOBAL_CONF.getBool("sml.tree.histSubtraction")
 
 
+def _hier_ici(mesh=None) -> int:
+    """Static ICI-hop width of the two-level histogram allreduce: the
+    mesh's "ici" axis size when the mesh declares the host topology
+    (`mesh.host_mesh`) and `sml.tree.hierarchicalAllreduce` allows it,
+    else 0 (= flat single-hop psum). Resolved at PROGRAM BUILD time and
+    part of every tree program cache key — toggling the knob or changing
+    the group shape must compile a fresh program, never replay one traced
+    under the other reduction structure."""
+    from ..conf import GLOBAL_CONF
+    mesh = mesh or meshlib.get_mesh()
+    if not meshlib.is_hierarchical(mesh):
+        return 0
+    mode = str(GLOBAL_CONF.get("sml.tree.hierarchicalAllreduce")
+               or "auto").strip().lower()
+    if mode in ("false", "0", "off", "no"):
+        return 0
+    return int(mesh.shape[meshlib.ICI_AXIS])
+
+
 def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
                        subtract: bool = True, kernel: str = "xla",
-                       block_rows: int = 0):
+                       block_rows: int = 0, axes=None, hier_ici: int = 0):
     """Pure per-chip tree-build fn (called inside shard_map): one level-wise
     pass, histograms as one-hot dots, psum merges. Returns stacked node
     arrays as a single (5, n_nodes) f32 pack (one transfer, one scan slot).
@@ -418,6 +437,19 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
     function's XLA branch, asserted by tests/test_hist_kernel.py)."""
     D, B, F = spec.max_depth, spec.n_bins, spec.n_features
     n_nodes = 2 ** (D + 1) - 1
+    axes = tuple(axes) if axes else (meshlib.DATA_AXIS,)
+
+    def _psum_merge(part):
+        # the post-histogram merge: hierarchical two-level reduce when the
+        # program was built for a host mesh with the knob on (hier_ici is
+        # the static ici width), else the flat allreduce over the row
+        # axes — same result, different hop structure and byte counters
+        if hier_ici > 1:
+            return coll.psum_hierarchical(
+                part, ici_axis=meshlib.ICI_AXIS,
+                dcn_axis=meshlib.DCN_AXIS, ici_size=hier_ici)
+        return coll.psum(part, axes if len(axes) > 1 else axes[0])
+
     use_pallas = kernel == "pallas"
     if use_pallas:
         from ..native import hist_kernel as _hk
@@ -496,7 +528,7 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
                 part = jax.lax.dot_general(
                     B1t, ns, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            hist = coll.psum(part)
+            hist = _psum_merge(part)
             if subtract and level > 0:
                 half = width // 2
                 left = hist.reshape(F, B, half, 3)
@@ -604,7 +636,7 @@ def _make_tree_builder(spec: TreeSpec, hist_dtype=jnp.float32,
         wq = jnp.where(in_level, weight, 0.0)
         node1hot = jax.nn.one_hot(lid_c, width, dtype=jnp.float32) \
             * (wq > 0)[:, None]
-        lstats = coll.psum(node1hot.T @ jnp.stack(
+        lstats = _psum_merge(node1hot.T @ jnp.stack(
             [grad * wq, hess * wq, wq], axis=1))
         idx = base + jnp.arange(width)
         node_G = node_G.at[idx].set(lstats[:, 0])
@@ -657,13 +689,18 @@ class EnsembleSpec(NamedTuple):
 _ensemble_cache: Dict[EnsembleSpec, object] = {}
 
 
-def _base_margin_fn(loss: str):
+def _base_margin_fn(loss: str, axes=None):
     """Per-chip base-margin statistic (mean / log-odds of the masked
     labels) with ONE fused allreduce for both sufficient statistics —
     shared by the monolithic ensemble program and the chunked boosting
-    path's standalone base program, so both produce bit-identical bases."""
+    path's standalone base program, so both produce bit-identical bases.
+    `axes` generalizes the reduction to a host mesh's row-axis tuple."""
+    ax = tuple(axes) if axes else (meshlib.DATA_AXIS,)
+    ax = ax if len(ax) > 1 else ax[0]
+
     def base_fn(y, mask):
-        n_tot, y_tot = coll.psum_scalars(jnp.sum(mask), jnp.sum(y * mask))
+        n_tot, y_tot = coll.psum_scalars(jnp.sum(mask), jnp.sum(y * mask),
+                                         axis=ax)
         if loss == "logistic":
             p0 = jnp.clip(y_tot / n_tot, 1e-6, 1 - 1e-6)
             return jnp.log(p0 / (1 - p0))
@@ -672,7 +709,7 @@ def _base_margin_fn(loss: str):
     return base_fn
 
 
-def _sliced_draw(n: int, data_width: int, draw):
+def _sliced_draw(n: int, data_width: int, draw, axes=None):
     """Mesh-layout-INVARIANT sampling weights: every chip draws the FULL
     padded row space (`n * data_width` values — counter-based threefry,
     a few cheap VPU passes next to the histogram matmuls) from the same
@@ -685,11 +722,14 @@ def _sliced_draw(n: int, data_width: int, draw):
     if data_width <= 1:
         return draw((n,))
     full = draw((n * data_width,))
-    return jax.lax.dynamic_slice(full, (coll.axis_index() * n,), (n,))
+    ax = tuple(axes) if axes else (meshlib.DATA_AXIS,)
+    idx = coll.axis_index(ax if len(ax) > 1 else ax[0])
+    return jax.lax.dynamic_slice(full, (idx * n,), (n,))
 
 
 def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1,
-                     kernel: str = "xla", block_rows: int = 0):
+                     kernel: str = "xla", block_rows: int = 0,
+                     axes=None, hier_ici: int = 0):
     """The shared internals of every ensemble program shape: `prepare`
     widens the compact quantized bins on-device and hoists the one-hot
     transpose; `make_round` returns the per-round scan body. Factored so
@@ -704,7 +744,8 @@ def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1,
     spec = es.tree
     hist_dtype = _hist_dtype()
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
-                               kernel=kernel, block_rows=block_rows)
+                               kernel=kernel, block_rows=block_rows,
+                               axes=axes, hier_ici=hier_ici)
     B, F = spec.n_bins, spec.n_features
 
     def prepare(binned, rng):
@@ -744,10 +785,10 @@ def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1,
             kt = jax.random.fold_in(key, t)
             if es.bootstrap and es.n_trees > 1:
                 w = _sliced_draw(n, data_width, lambda s: jax.random.poisson(
-                    kt, es.subsample, s).astype(jnp.float32))
+                    kt, es.subsample, s).astype(jnp.float32), axes)
             elif es.subsample < 1.0:
                 w = _sliced_draw(n, data_width, lambda s: jax.random.bernoulli(
-                    kt, es.subsample, s).astype(jnp.float32))
+                    kt, es.subsample, s).astype(jnp.float32), axes)
             else:
                 w = jnp.ones((n,), jnp.float32)
             w = w * mask
@@ -767,22 +808,26 @@ def _ensemble_pieces(es: EnsembleSpec, data_width: int = 1,
 
 
 def _data_width(mesh=None) -> int:
-    """The mesh's static data-axis size — the sampling-slice factor every
+    """The mesh's static row-shard count — the sampling-slice factor every
     program maker threads into `_ensemble_pieces` (programs cache per
-    mesh id, so the width is as static as the mesh)."""
+    mesh id, so the width is as static as the mesh). On a hierarchical
+    host mesh this is DCN×ICI — rows shard over both hops."""
     mesh = mesh or meshlib.get_mesh()
+    if meshlib.is_hierarchical(mesh):
+        return meshlib.data_width(mesh)
     return int(mesh.shape.get(meshlib.DATA_AXIS, 1))
 
 
 def _make_ensemble_program(es: EnsembleSpec, data_width: int = 1,
-                           kernel: str = "xla", block_rows: int = 0):
+                           kernel: str = "xla", block_rows: int = 0,
+                           axes=None, hier_ici: int = 0):
     """The WHOLE forest/boosting fit as one XLA program: `lax.scan` over
     trees, margins and sampling weights living in HBM for the entire fit.
     One dispatch + one packed device→host transfer per ensemble — the
     per-tree host round-trips (expensive over a TPU tunnel) disappear."""
     prepare, make_round = _ensemble_pieces(es, data_width, kernel,
-                                           block_rows)
-    base_of = _base_margin_fn(es.loss)
+                                           block_rows, axes, hier_ici)
+    base_of = _base_margin_fn(es.loss, axes)
 
     def program(binned, y, mask, rng):
         binned, binned_c, B1t, key = prepare(binned, rng)
@@ -796,13 +841,14 @@ def _make_ensemble_program(es: EnsembleSpec, data_width: int = 1,
 
 
 def _make_chunk_program(es: EnsembleSpec, chunk: int, data_width: int = 1,
-                        kernel: str = "xla", block_rows: int = 0):
+                        kernel: str = "xla", block_rows: int = 0,
+                        axes=None, hier_ici: int = 0):
     """`chunk` boosting rounds as one dispatch: the margin carry enters and
     leaves as a row-sharded HBM buffer (donated between dispatches by the
     caller), `t0` offsets the round index so sampling streams and feature
     subspaces match the monolithic scan round-for-round."""
     prepare, make_round = _ensemble_pieces(es, data_width, kernel,
-                                           block_rows)
+                                           block_rows, axes, hier_ici)
 
     def program(binned, y, mask, margin, rng, t0):
         binned, binned_c, B1t, key = prepare(binned, rng)
@@ -836,14 +882,16 @@ def _compiled_chunk(es: EnsembleSpec, chunk: int,
     plat = _mesh_platform(mesh)
     donate = (3,) if plat != "cpu" \
         and GLOBAL_CONF.getBool("sml.tpu.donate") else ()
-    key = (es, chunk, id(mesh), _hist_subtract(), donate, kernel, brows)
+    key = (es, chunk, id(mesh), _hist_subtract(), _hier_ici(mesh), donate,
+           kernel, brows)
     if key not in _chunk_cache:
         from ..obs import note_compile
         note_compile(f"tree_chunk_{chunk}")
         program = _make_chunk_program(es, chunk, _data_width(mesh), kernel,
-                                      brows)
+                                      brows, _meshlib.row_axes(mesh),
+                                      _hier_ici(mesh))
         P = jax.sharding.PartitionSpec
-        Dx = _meshlib.DATA_AXIS
+        Dx = _meshlib.row_spec_entry(mesh)
         wrapped = _meshlib.shard_map_compat(
             program, mesh=mesh,
             in_specs=(P(Dx, None), P(Dx), P(Dx), P(Dx), P(), P()),
@@ -912,7 +960,8 @@ def _fit_ensemble_chunked(binned_dev, y_dev, mask_dev, es: EnsembleSpec,
     kernel = kernel or _kernel_for(es.tree)
     bkey = (es.loss, id(mesh))
     if bkey not in _base_prog_cache:
-        _base_prog_cache[bkey] = data_parallel(_base_margin_fn(es.loss))
+        _base_prog_cache[bkey] = data_parallel(
+            _base_margin_fn(es.loss, _meshlib.row_axes(mesh)))
     base = float(jax.device_get(_base_prog_cache[bkey](y_dev, mask_dev)))
     margin = jax.device_put(
         np.full((binned_dev.shape[0],), base, np.float32),
@@ -1050,12 +1099,14 @@ def _ensemble_compiled(es: EnsembleSpec, kernel: Optional[str] = None,
     kernel = kernel or _kernel_for(es.tree)
     brows = _kernel_block_rows(kernel) if block_rows is None \
         else int(block_rows)
-    key = (es, id(meshlib.get_mesh()), _hist_subtract(), kernel, brows)
+    mesh = meshlib.get_mesh()
+    key = (es, id(mesh), _hist_subtract(), _hier_ici(mesh), kernel, brows)
     if key not in _ensemble_cache:
         from ..obs import note_compile
         note_compile("tree_ensemble")
         _ensemble_cache[key] = data_parallel(
-            _make_ensemble_program(es, _data_width(), kernel, brows),
+            _make_ensemble_program(es, _data_width(mesh), kernel, brows,
+                                   meshlib.row_axes(mesh), _hier_ici(mesh)),
             replicated_argnums=(3,))
     return _ensemble_cache[key]
 
@@ -1118,7 +1169,7 @@ def build_fold_stacks(binned_list, y_list):
     their ids valid)."""
     from ..parallel import mesh as _meshlib
     mesh = _meshlib.get_mesh()
-    n_dev = mesh.shape[_meshlib.DATA_AXIS]
+    n_dev = _data_width(mesh)
     n_pad = max(_meshlib.bucket_rows(b.shape[0], n_dev)
                 for b in binned_list)
     key = (tuple(id(b) for b in binned_list),
@@ -1216,19 +1267,21 @@ def _folds_compiled(es: EnsembleSpec, fo: int, kernel: Optional[str] = None,
     kernel = kernel or _kernel_for(es.tree)
     brows = _kernel_block_rows(kernel) if block_rows is None \
         else int(block_rows)
-    key = (es, fo, id(mesh), _hist_subtract(), kernel, brows)
+    key = (es, fo, id(mesh), _hist_subtract(), _hier_ici(mesh), kernel,
+           brows)
     if key not in _folds_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_folds_{fo}")
         program = _make_ensemble_program(es, _data_width(mesh), kernel,
-                                         brows)
+                                         brows, meshlib.row_axes(mesh),
+                                         _hier_ici(mesh))
 
         def batched(binned_f, y_f, mask_f, rng):
             return jax.vmap(program, in_axes=(0, 0, 0, None))(
                 binned_f, y_f, mask_f, rng)
 
         P = jax.sharding.PartitionSpec
-        D = meshlib.DATA_AXIS
+        D = meshlib.row_spec_entry(mesh)
         wrapped = meshlib.shard_map_compat(
             batched, mesh=mesh,
             in_specs=(P(None, D, None), P(None, D), P(None, D), P()),
@@ -1242,7 +1295,8 @@ _trials_cache: Dict[tuple, object] = {}
 
 
 def _make_trials_program(es: EnsembleSpec, data_width: int = 1,
-                         kernel: str = "xla", block_rows: int = 0):
+                         kernel: str = "xla", block_rows: int = 0,
+                         axes=None, hier_ici: int = 0):
     """Per-ELEMENT ensemble program with TRACED hyperparameters, vmapped
     over the trial axis by `fit_ensembles_trials`: `es` carries the grid
     MAXIMA as static shapes (max_depth, n_bins, n_trees), and each
@@ -1256,9 +1310,10 @@ def _make_trials_program(es: EnsembleSpec, data_width: int = 1,
     spec = es.tree
     hist_dtype = _hist_dtype()
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
-                               kernel=kernel, block_rows=block_rows)
+                               kernel=kernel, block_rows=block_rows,
+                               axes=axes, hier_ici=hier_ici)
     B, F = spec.n_bins, spec.n_features
-    base_of = _base_margin_fn(es.loss)
+    base_of = _base_margin_fn(es.loss, axes)
 
     def program(binned, y, mask, rng, depth, feature_k, min_inst, mig,
                 bootstrap, subsample):
@@ -1280,9 +1335,9 @@ def _make_trials_program(es: EnsembleSpec, data_width: int = 1,
             hess = jnp.ones_like(y)
             kt = jax.random.fold_in(key, t)
             pois = _sliced_draw(n, data_width, lambda s: jax.random.poisson(
-                kt, subsample, s).astype(jnp.float32))
+                kt, subsample, s).astype(jnp.float32), axes)
             bern = _sliced_draw(n, data_width, lambda s: jax.random.bernoulli(
-                kt, subsample, s).astype(jnp.float32))
+                kt, subsample, s).astype(jnp.float32), axes)
             ones = jnp.ones((n,), jnp.float32)
             w = jnp.where(bootstrap, pois,
                           jnp.where(subsample < 1.0, bern, ones)) * mask
@@ -1313,12 +1368,14 @@ def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None,
     kernel = kernel or _kernel_for(es.tree)
     brows = _kernel_block_rows(kernel) if block_rows is None \
         else int(block_rows)
-    key = (es, n_elems, id(mesh), _hist_subtract(), kernel, brows)
+    key = (es, n_elems, id(mesh), _hist_subtract(), _hier_ici(mesh),
+           kernel, brows)
     if key not in _trials_cache:
         from ..obs import note_compile
         note_compile(f"tree_ensemble_trials_{n_elems}")
         program = _make_trials_program(es, _data_width(mesh), kernel,
-                                       brows)
+                                       brows, meshlib.row_axes(mesh),
+                                       _hier_ici(mesh))
 
         def batched(binned_e, y_e, mask_e, rngs, *dyns):
             return jax.vmap(program,
@@ -1333,7 +1390,11 @@ def _trials_compiled(es: EnsembleSpec, n_elems: int, mesh=None,
                 + (P(T),) * 6
             out_specs = (P(T), P(T))
         else:
-            in_specs = (P(None, D, None), P(None, D), P(None, D)) \
+            # replicated-element layout: rows shard over the mesh's row
+            # axes (the host mesh's ("dcn", "ici") tuple included — the
+            # fused-trial path on a host-partitioned mesh)
+            Dr = meshlib.row_spec_entry(mesh)
+            in_specs = (P(None, Dr, None), P(None, Dr), P(None, Dr)) \
                 + (P(),) * 7
             out_specs = (P(), P())
         wrapped = meshlib.shard_map_compat(
@@ -1501,7 +1562,7 @@ def _replay_zeros(meta, n: int):
         a = np.zeros(tuple(shape), dtype=np.dtype(dtype))
         if stacked and a.ndim >= 2:  # (elems/folds, rows, ...) layout
             spec = jax.sharding.PartitionSpec(
-                None, meshlib.DATA_AXIS, *([None] * (a.ndim - 2)))
+                None, meshlib.row_spec_entry(mesh), *([None] * (a.ndim - 2)))
             out.append(jax.device_put(
                 a, jax.sharding.NamedSharding(mesh, spec)))
         else:
@@ -1589,11 +1650,13 @@ _register_prewarm_rebuilders()
 
 
 def _build_tree_program(spec: TreeSpec, hist_dtype=jnp.float32,
-                        kernel: str = "xla", block_rows: int = 0):
+                        kernel: str = "xla", block_rows: int = 0,
+                        axes=None, hier_ici: int = 0):
     """Single-tree program (kept for the dryrun/compile-check path)."""
     B, F = spec.n_bins, spec.n_features
     build = _make_tree_builder(spec, hist_dtype, subtract=_hist_subtract(),
-                               kernel=kernel, block_rows=block_rows)
+                               kernel=kernel, block_rows=block_rows,
+                               axes=axes, hier_ici=hier_ici)
 
     def program(binned, grad, hess, weight, feat_rng):
         n = binned.shape[0]
@@ -1621,12 +1684,14 @@ def fit_tree(binned_dev, grad_dev, hess_dev, weight_dev, spec: TreeSpec,
     from ..parallel import mesh as _meshlib
     kernel = _kernel_for(spec)
     brows = _kernel_block_rows(kernel)
-    key = (spec, id(_meshlib.get_mesh()), _hist_subtract(), kernel, brows)
+    mesh = _meshlib.get_mesh()
+    key = (spec, id(mesh), _hist_subtract(), _hier_ici(mesh), kernel, brows)
     if key not in _tree_cache:
         from ..obs import note_compile
         note_compile("tree_single")
         _tree_cache[key] = data_parallel(
-            _build_tree_program(spec, _hist_dtype(), kernel, brows),
+            _build_tree_program(spec, _hist_dtype(), kernel, brows,
+                                _meshlib.row_axes(mesh), _hier_ici(mesh)),
             replicated_argnums=(4,))
     compiled = _tree_cache[key]
     if feat_key is None:

@@ -78,6 +78,7 @@ class SkewTracker:
     # ------------------------------------------------------------ recording
     def note(self, program: str, compute_s: Sequence[float], *,
              devices: Optional[Sequence[int]] = None,
+             hosts: Optional[Sequence[int]] = None,
              t0: Optional[float] = None,
              wall_s: Optional[float] = None,
              psum_bytes: Optional[float] = None,
@@ -86,7 +87,13 @@ class SkewTracker:
         measured compute seconds. `devices[i]` is that device's REAL id
         (pass `jax.Device.id`s so the report indicts the right physical
         chip when shard row-order differs from device numbering; default
-        = positional 0..n-1). `wall_s` (the fused program's actual wall)
+        = positional 0..n-1). `hosts[i]` is device i's HOST-GROUP index
+        on a hierarchical mesh (`parallel.mesh.host_group_of`): the same
+        BSP decomposition then also runs one level up — a group's
+        compute is its slowest member's (the group syncs internally
+        before the DCN hop), and the report names the slowest HOST next
+        to the slowest chip, with `{prefix}.host.compute`/`.wait` lanes
+        on the trace. `wall_s` (the fused program's actual wall)
         separates collective/dispatch overhead from the straggler wait;
         `psum_bytes`/`psum_launches` carry the PR-6 trace-time collective
         volume. Returns the per-program attribution dict (None when the
@@ -106,6 +113,19 @@ class SkewTracker:
         entry["devices"] = ids
         entry["per_device_compute_s"] = compute
         entry["slowest_device"] = ids[entry.pop("slowest_pos")]
+        per_host: Dict[int, float] = {}
+        if hosts is not None:
+            gids = [int(g) for g in hosts]
+            if len(gids) != len(compute):
+                raise ValueError(f"{len(gids)} host-group ids for "
+                                 f"{len(compute)} compute timings")
+            for g, c in zip(gids, compute):
+                per_host[g] = max(per_host.get(g, 0.0), c)
+            entry["host_ids"] = sorted(per_host)
+            entry["per_host_compute_s"] = [per_host[g]
+                                           for g in entry["host_ids"]]
+            entry["slowest_host"] = max(
+                entry["host_ids"], key=lambda g: per_host[g])
         if wall_s is not None:
             entry["wall_s"] = float(wall_s)
             # the fused wall beyond the slowest chip's compute: the
@@ -151,9 +171,23 @@ class SkewTracker:
                 self._rec.emit("span", f"{self._prefix}.wait", dur=mx - c,
                                ts=start + c,
                                args={"device": d, "program": program})
+        if per_host:
+            # host-level lanes: one per group, wait measured to the
+            # slowest GROUP's finish — the DCN-hop sync point
+            hmx = max(per_host.values())
+            for g in sorted(per_host):
+                c = per_host[g]
+                self._rec.emit("span", f"{self._prefix}.host.compute",
+                               dur=c, ts=start,
+                               args={"host": g, "program": program})
+                if hmx - c > 0:
+                    self._rec.emit("span", f"{self._prefix}.host.wait",
+                                   dur=hmx - c, ts=start + c,
+                                   args={"host": g, "program": program})
         self._rec.emit(self._prefix, f"{self._prefix}.note", args={
             "program": program, "n_devices": entry["n_devices"],
             "slowest_device": entry["slowest_device"],
+            "slowest_host": entry.get("slowest_host"),
             "skew_ratio": round(entry["skew_ratio"], 4),
             "wait_share": round(entry["wait_share"], 4),
             "psum_bytes": psum_bytes, "psum_launches": psum_launches})
@@ -192,7 +226,35 @@ def _aggregate(programs: List[dict], compute: Dict[int, float],
     mean = total_compute / len(devices)
     psum_bytes = sum(p.get("psum_bytes") or 0.0 for p in programs)
     launches = sum(p.get("psum_launches") or 0.0 for p in programs)
+    # host-level roll-up over the programs that carried group ids
+    # (multi-host probes): totals per group, wait to the slowest group
+    hcomp: Dict[int, float] = {}
+    hwait: Dict[int, float] = {}
+    for p in programs:
+        gids = p.get("host_ids")
+        if not gids:
+            continue
+        comps = p["per_host_compute_s"]
+        hmx = max(comps)
+        for g, c in zip(gids, comps):
+            hcomp[g] = hcomp.get(g, 0.0) + c
+            hwait[g] = hwait.get(g, 0.0) + (hmx - c)
+    host_block = {}
+    if hcomp:
+        hids = sorted(hcomp)
+        hslow = max(hids, key=lambda g: hcomp[g])
+        hmean = sum(hcomp.values()) / len(hids)
+        host_block = {
+            "n_hosts": len(hids),
+            "slowest_host": hslow,
+            "host_skew_ratio": round(hcomp[hslow] / hmean, 4)
+            if hmean > 0 else 1.0,
+            "per_host": [{"host": g,
+                          "compute_s": round(hcomp[g], 6),
+                          "wait_s": round(hwait[g], 6)} for g in hids],
+        }
     return {
+        **host_block,
         "n_devices": len(devices),
         "programs": len(programs),
         "slowest_device": slowest,

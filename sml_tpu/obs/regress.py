@@ -18,6 +18,12 @@ didn't eyeball PERF.md closely enough. `compare()` is the machine check:
 - **collective volume**: the multichip block's per-trace psum
   launches/bytes are STATICS of the compiled program — any growth is a
   real change, tolerated only 1%;
+- **multihost scaling**: the sidecar `multihost` block's per-shape
+  hierarchical-collective statics (the DCN hop's psum bytes growing
+  back toward the flat-allreduce payload is the regression the
+  two-level reduce exists to prevent — 1% static tolerance), its
+  H-host-vs-1-host fit-parity proof, and its per-host skew table must
+  not vanish or flip;
 - **serving percentiles**: load numbers on a shared host, judged at a
   generous 50%;
 - **coverage**: a leg present in the base but missing from the
@@ -137,6 +143,7 @@ def normalize(doc: dict) -> dict:
                         (doc.get("metrics") or {}).items()
                         if isinstance(v, (int, float))},
             "multichip": doc.get("multichip"),
+            "multihost": doc.get("multihost"),
             "kernel": doc.get("kernel"),
             "kernel_infer": doc.get("kernel_infer"),
             "scale": doc.get("scale"),
@@ -168,6 +175,7 @@ def normalize(doc: dict) -> dict:
         "legs": legs,
         "metrics": metrics,
         "multichip": mc,
+        "multihost": doc.get("multihost"),
         "kernel": doc.get("kernel"),
         "kernel_infer": doc.get("kernel_infer"),
         "scale": doc.get("scale"),
@@ -346,6 +354,72 @@ def compare(base: dict, cand: dict, min_tol: float = MIN_TOL) -> dict:
                             "multichip-collective", f"{w}dev:{key}", bv,
                             cv, slack, "regression",
                             "per-trace collective static grew"))
+
+    # ---- multihost scaling block (hierarchical-collective statics,
+    # DCN-byte fractions, parity proofs, host-skew coverage)
+    bmh, cmh = base.get("multihost"), cand.get("multihost")
+    if bmh and not cmh and cand.get("shape") != "record":
+        # coverage rule, like the kernel/scale blocks: bench.py carries
+        # the block across plain suite runs, so a SIDECAR candidate
+        # missing it actually lost the --multihost gate; BENCH_r0x
+        # driver records can never carry it, so they are exempt
+        reg.append(_finding(
+            "missing-multihost-block", "multihost", 1.0, 0.0, 0.0,
+            "regression",
+            "multihost block present in base, absent in candidate"))
+    if bmh and cmh:
+        csh = {int(e["hosts"]): e for e in cmh.get("shapes", [])}
+        for e in bmh.get("shapes", []):
+            h = int(e["hosts"])
+            ce = csh.get(h)
+            tag = f"{h}host"
+            if ce is None:
+                reg.append(_finding(
+                    "missing-multihost-shape", tag, 1.0, 0.0, 0.0,
+                    "regression",
+                    "host-group shape present in base, absent in "
+                    "candidate"))
+                continue
+            checked += 1
+            tol = max(TOL_CAP, min_tol)  # best-of-3, no recorded passes
+            bs, cs = float(e.get("seconds", 0)), float(ce.get("seconds", 0))
+            if bs and cs / bs - 1.0 > tol:
+                reg.append(_finding("multihost-wall", tag, bs, cs, tol,
+                                    "regression"))
+            # per-hop collective statics of the compiled program: any
+            # growth is a real change — the DCN hop ballooning back
+            # toward the flat-allreduce payload is exactly the
+            # regression the hierarchical path exists to prevent
+            for key in ("psum_bytes_dcn", "psum_bytes_ici",
+                        "psum_dcn", "psum_ici"):
+                bv, cv = float(e.get(key, 0)), float(ce.get(key, 0))
+                if bv > 0:
+                    checked += 1
+                    if cv > bv * (1.0 + STATIC_TOL):
+                        reg.append(_finding(
+                            "multihost-collective", f"{tag}:{key}", bv,
+                            cv, STATIC_TOL, "regression",
+                            "per-hop collective static grew"))
+            # parity proof: an H-host fit matching the 1-host fit is a
+            # correctness gate, not a perf number — a flip flags
+            if e.get("parity_ok"):
+                checked += 1
+                if ce.get("parity_ok") is not True:
+                    reg.append(_finding(
+                        "multihost-parity", f"{tag}:parity_ok", 1.0, 0.0,
+                        0.0, "regression",
+                        "H-host fit no longer matches the 1-host fit — "
+                        "layout-invariant sampling broke"))
+            # host-skew coverage: a base shape that attributed per-host
+            # compute must keep being able to name its slowest host
+            if e.get("host_skew"):
+                checked += 1
+                if not ce.get("host_skew"):
+                    reg.append(_finding(
+                        "multihost-skew", f"{tag}:host_skew", 1.0, 0.0,
+                        0.0, "regression",
+                        "per-host skew table vanished — straggler "
+                        "attribution lost its host lanes"))
 
     # ---- kernelbench block (pallas vs xla sweep + kernel.* counters)
     bk, ck = base.get("kernel"), cand.get("kernel")

@@ -122,6 +122,12 @@ COUNTERS = {
     # / ct.cycle_error (background-loop cycles that raised — the loop
     # survives, the failure is visible)
     "ct.*",
+    # elastic multi-host fits (sml_tpu/ct/_elastic.py): elastic.resume
+    # (one HostPreempted caught and resumed from the newest round-level
+    # checkpoint) / elastic.repartition (the chunk ranges re-split to
+    # the surviving host-group count) — paired 1:1 today, kept separate
+    # so a future rebalance-without-preemption path counts honestly
+    "elastic.*",
     # multi-replica serving fleet (sml_tpu/fleet): fleet.requests /
     # fleet.requests.<class> (router admissions by priority class) /
     # fleet.shed + fleet.shed.<class> (router-level priority sheds) /
@@ -219,6 +225,10 @@ EVENTS = {
     # ct.promote (canary gate passed — Production moved), ct.rollback
     # (gate failed — candidate archived, blackbox bundle path in args)
     "ct.*",
+    # elastic multi-host fits (sml_tpu/ct/_elastic.py): elastic.resume
+    # receipts carrying from_hosts/to_hosts, the dead group, and the
+    # rows whose host assignment moved under the re-partition
+    "elastic.*",
     # multi-replica serving fleet (sml_tpu/fleet): fleet.route (one
     # router decision: replica, priority class, the request's trace id
     # — the router half of the fan-in chain) / fleet.reroute (a

@@ -21,6 +21,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"    # row / batch parallelism (Spark partitions → chips)
 MODEL_AXIS = "model"  # feature/block parallelism (Gram blocks, ALS factors)
 TRIAL_AXIS = "trial"  # fused (grid point × fold) trial parallelism
+DCN_AXIS = "dcn"      # inter-host hop of a hierarchical (host-grouped) mesh
+ICI_AXIS = "ici"      # intra-host hop of a hierarchical (host-grouped) mesh
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs):
@@ -179,10 +181,141 @@ def trial_mesh(trial_dim: int, mesh: Optional[Mesh] = None) -> Mesh:
     return _trial_mesh_cache[key]
 
 
-def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
-    """Rows sharded over DATA_AXIS, everything else replicated."""
+_host_mesh_cache: dict = {}
+
+
+def host_mesh(hosts: Optional[int] = None,
+              devices_per_host: Optional[int] = None,
+              mesh: Optional[Mesh] = None) -> Mesh:
+    """A 2-D ``(DCN_AXIS, ICI_AXIS)`` host-major mesh: row 0 is host group
+    0's devices, row 1 host group 1's, ... — the topology a hierarchical
+    allreduce exploits (cheap wide ICI within a row, narrow DCN across
+    rows).
+
+    On a single machine the groups are VIRTUAL hosts: the flat device set
+    partitioned into `hosts` contiguous groups, so the whole multi-host
+    code path is testable on the simulated 8-device CPU mesh. On a real
+    multi-process TPU slice (`jax.process_count() > 1`) the groups are the
+    `jax.process_index()` slices — one row per process — and `hosts`
+    defaults to the process count.
+
+    Because device d of the flat mesh lands at (d // per, d % per), row
+    sharding over ``(DCN_AXIS, ICI_AXIS)`` places every global row on
+    exactly the device the flat mesh would — the PR-6 layout-invariant
+    sampling contract carries over unchanged, whatever the group shape.
+
+    Memoized per (devices, hosts) so repeated fits reuse identical Mesh
+    objects and hit the per-mesh program caches instead of recompiling."""
+    import jax as _jax
+    base = mesh.devices.flat if mesh is not None else _jax.devices()
+    devices = list(base)
+    n = len(devices)
+    if hosts is None or hosts <= 0:
+        from ..conf import GLOBAL_CONF as _CONF
+        hosts = int(_CONF.get("sml.mesh.hostGroups") or 0)
+    if hosts <= 0:
+        pc = _jax.process_count()
+        hosts = pc if pc > 1 else 1
+    hosts = max(1, min(int(hosts), n))
+    if devices_per_host is None:
+        if n % hosts:
+            raise ValueError(f"{hosts} host groups do not divide the "
+                             f"{n}-device set")
+        devices_per_host = n // hosts
+    if hosts * devices_per_host != n:
+        raise ValueError(f"host mesh {hosts}x{devices_per_host} != device "
+                         f"count {n}")
+    if _jax.process_count() > 1 and hosts == _jax.process_count():
+        # real multi-host: one row per process, devices in process order
+        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    key = (tuple(id(d) for d in devices), hosts)
+    if key not in _host_mesh_cache:
+        _host_mesh_cache[key] = Mesh(
+            np.asarray(devices).reshape(hosts, devices_per_host),
+            (DCN_AXIS, ICI_AXIS))
+    return _host_mesh_cache[key]
+
+
+def is_hierarchical(mesh: Optional[Mesh] = None) -> bool:
+    """True when the mesh declares the two-hop host topology — the signal
+    `sml.tree.hierarchicalAllreduce=auto` keys on."""
     mesh = mesh or get_mesh()
-    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return DCN_AXIS in mesh.shape and ICI_AXIS in mesh.shape
+
+
+def row_axes(mesh: Optional[Mesh] = None) -> Tuple[str, ...]:
+    """The mesh axes rows shard over: ``(DCN_AXIS, ICI_AXIS)`` on a
+    hierarchical host mesh, ``(DATA_AXIS,)`` everywhere else."""
+    mesh = mesh or get_mesh()
+    if is_hierarchical(mesh):
+        return (DCN_AXIS, ICI_AXIS)
+    return (DATA_AXIS,)
+
+
+def row_spec_entry(mesh: Optional[Mesh] = None):
+    """The PartitionSpec element that shards rows on this mesh: the plain
+    DATA_AXIS name, or the ("dcn", "ici") tuple that splits rows over both
+    hops of a host mesh (host-major, so placement matches the flat mesh)."""
+    ax = row_axes(mesh)
+    return ax if len(ax) > 1 else ax[0]
+
+
+def data_width(mesh: Optional[Mesh] = None) -> int:
+    """Number of row shards: the flat data-axis size, or DCN×ICI on a
+    hierarchical host mesh. Every `mesh.shape[DATA_AXIS]` site reads this
+    instead so host meshes ride the same staging/padding arithmetic."""
+    mesh = mesh or get_mesh()
+    if is_hierarchical(mesh):
+        return int(mesh.shape[DCN_AXIS]) * int(mesh.shape[ICI_AXIS])
+    return int(mesh.shape[DATA_AXIS])
+
+
+def host_group_of(mesh: Optional[Mesh] = None) -> dict:
+    """device id → host-group index (the mesh's DCN row); flat meshes map
+    every device to group 0 — the lookup straggler probes use to feed
+    per-host skew lanes (obs/_skew.py)."""
+    mesh = mesh or get_mesh()
+    if not is_hierarchical(mesh):
+        return {d.id: 0 for d in mesh.devices.flat}
+    rows = mesh.devices.reshape(int(mesh.shape[DCN_AXIS]), -1)
+    return {d.id: g for g, row in enumerate(rows) for d in row}
+
+
+def host_partition(n_rows: int, hosts: int) -> list:
+    """Contiguous [start, stop) global row ranges, one per host group —
+    the per-host data-plane split. Host-major row sharding places block g
+    exactly on group g's devices, so a ChunkSource host-view reading only
+    its range feeds its own group's HBM without cross-host traffic.
+    Remainder rows go to the leading groups (matching np.array_split)."""
+    hosts = max(1, int(hosts))
+    n = max(0, int(n_rows))
+    per, extra = divmod(n, hosts)
+    out, start = [], 0
+    for g in range(hosts):
+        stop = start + per + (1 if g < extra else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def host_row_blocks(arr, mesh: Optional[Mesh] = None) -> list:
+    """Per-host view of a row-sharded array: one (group_index, [(device,
+    shard_block), ...]) pair per host group, blocks ordered by row
+    position within the group — the group-aware iteration a multi-host
+    skew probe walks (each block resident on its device, so timing an op
+    over it measures that chip alone, attributable to its host)."""
+    mesh = mesh or get_mesh()
+    groups = host_group_of(mesh)
+    out: dict = {}
+    for dev, blk in addressable_row_blocks(arr):
+        out.setdefault(groups.get(dev.id, 0), []).append((dev, blk))
+    return sorted(out.items())
+
+
+def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
+    """Rows sharded over the mesh's row axes, everything else replicated."""
+    mesh = mesh or get_mesh()
+    spec = P(row_spec_entry(mesh), *([None] * (ndim - 1)))
     return NamedSharding(mesh, spec)
 
 
@@ -224,7 +357,7 @@ def shard_rows(x: np.ndarray, mesh: Optional[Mesh] = None) -> Tuple[jax.Array, i
     per-chip-equal block, callers mask with the true count.
     """
     mesh = mesh or get_mesh()
-    n_dev = mesh.shape[DATA_AXIS]
+    n_dev = data_width(mesh)
     padded, n_true = pad_rows(np.asarray(x), n_dev)
     arr = jax.device_put(padded, data_sharding(mesh, padded.ndim))
     return arr, n_true

@@ -142,7 +142,8 @@ def _guard_key() -> tuple:
 def _mesh_sig() -> list:
     from . import mesh as meshlib
     m = meshlib.get_mesh()
-    n = int(m.shape.get(meshlib.DATA_AXIS, 1))
+    n = meshlib.data_width(m) if meshlib.is_hierarchical(m) \
+        else int(m.shape.get(meshlib.DATA_AXIS, 1))
     plat = str(list(m.devices.flat)[0].platform)
     return [n, plat]
 

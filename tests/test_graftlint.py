@@ -908,6 +908,51 @@ def test_collective_axis_exempts_wrapper_composition(lint):
     assert rep.clean
 
 
+def test_collective_axis_flags_hierarchical_hop_typo(lint):
+    """psum_hierarchical names a sub-axis PER HOP: a typo'd `ici_axis=`
+    flags even when the dcn hop is right — exactly one violation, for
+    the bad hop, suggesting the declared names."""
+    rep = run_on(lint, {
+        "sml_tpu/parallel/mesh.py":
+            "DATA_AXIS = 'data'\nDCN_AXIS = 'dcn'\nICI_AXIS = 'ici'\n",
+        "sml_tpu/a.py": (
+            "DCN_AXIS = 'dcn'\n"
+            "def prog(x):\n"
+            "    return coll.psum_hierarchical(x, ici_axis='icy',"
+            " dcn_axis=DCN_AXIS, ici_size=4)\n"
+            "def getter(m, s, o):\n"
+            "    return shard_map_compat(prog, mesh=m, in_specs=s,"
+            " out_specs=o)\n")}, rules=CAD)
+    assert rules_fired(rep) == CAD
+    assert len(rep.violations) == 1
+    assert "'icy'" in rep.violations[0].message
+    assert "ici" in rep.violations[0].message
+
+
+def test_collective_axis_clean_on_hierarchical_hops(lint):
+    """Hop kwargs naming the declared sub-axis constants are clean, and
+    so is the kwarg-less call (the hop defaults bind inside
+    collectives.py, the sanctioned surface)."""
+    rep = run_on(lint, {
+        "sml_tpu/parallel/mesh.py":
+            "DATA_AXIS = 'data'\nDCN_AXIS = 'dcn'\nICI_AXIS = 'ici'\n",
+        "sml_tpu/a.py": (
+            "DCN_AXIS = 'dcn'\n"
+            "ICI_AXIS = 'ici'\n"
+            "def prog(x):\n"
+            "    return coll.psum_hierarchical(x, ici_axis=ICI_AXIS,"
+            " dcn_axis=DCN_AXIS, ici_size=4)\n"
+            "def prog2(x):\n"
+            "    return coll.psum_hierarchical(x, ici_size=2)\n"
+            "def getter(m, s, o):\n"
+            "    return shard_map_compat(prog, mesh=m, in_specs=s,"
+            " out_specs=o)\n"
+            "def getter2(m, s, o):\n"
+            "    return shard_map_compat(prog2, mesh=m, in_specs=s,"
+            " out_specs=o)\n")}, rules=CAD)
+    assert rep.clean
+
+
 # ------------------------------------- rule 12: divergent-collective (PR 18)
 DIV = ["divergent-collective"]
 
